@@ -1,0 +1,45 @@
+"""TPU-native byte-n-gram language-identification framework.
+
+Brand-new implementation of the capabilities of
+``leifblaese/spark-languagedetector`` (reference mounted at
+``/root/reference``), designed for JAX/XLA on TPU: fixed-shape byte batches,
+integer gram vocabularies, gather/matmul scoring on device, mesh-sharded
+distributed fit, and a Spark-ML-style Estimator/Model API on top.
+
+Public API::
+
+    from spark_languagedetector_tpu import (
+        LanguageDetector, LanguageDetectorModel, Language, Table,
+        LowerCasePreprocessor, SpecialCharPreprocessor,
+    )
+"""
+
+from .api.table import Schema, Table
+from .models.language import ISO_LANGUAGE_CODES, Language
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ISO_LANGUAGE_CODES",
+    "Language",
+    "LanguageDetector",
+    "LanguageDetectorModel",
+    "LowerCasePreprocessor",
+    "Schema",
+    "SpecialCharPreprocessor",
+    "Table",
+]
+
+
+def __getattr__(name):
+    # Lazy imports keep `import spark_languagedetector_tpu` light (no jax
+    # device init) until an estimator/model/preprocessor is actually used.
+    if name in ("LanguageDetector", "LanguageDetectorModel"):
+        from .models import estimator
+
+        return getattr(estimator, name)
+    if name in ("LowerCasePreprocessor", "SpecialCharPreprocessor"):
+        from .models import preprocessing
+
+        return getattr(preprocessing, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,101 @@
+"""Text preprocessors: lower-casing and special-character cleanup.
+
+TPU-native re-implementations of the reference's two Transformers
+(``/root/reference/src/main/.../preprocessing/``). Both preserve the
+reference's deliberate API quirks — documented because they are observable
+behavior (SURVEY.md §2.9 Q8):
+
+  * ``set_input_col`` sets the OUTPUT column (the transformers operate
+    in-place on one column, reading and writing ``outputCol``);
+  * ``transform_schema`` drops the column and re-appends it last.
+
+The reference's *broken* behaviors are fixed, not replicated (SURVEY.md Q3/Q4:
+its symbol regex is syntactically invalid and would throw on first use, and
+its whitespace rule deletes every space): this implementation strips the
+symbol set the reference *intended* (``"<[]>/\\`` plus the rest of the chars
+in its regex literal) and squashes whitespace runs to a single space.
+"""
+
+from __future__ import annotations
+
+import re
+
+from ..api.params import HasLabelCol, HasOutputCol
+from ..api.table import STRING, Schema, Table
+
+# The characters the reference's regex literal tried to express
+# (SpecialCharPreprocessor.scala:55): /_[]*()%^&@$#:|{}<>~`"\
+_SYMBOL_RE = re.compile(r'[/_\[\]*()%^&@$#:|{}<>~`"\\]')
+_WHITESPACE_RE = re.compile(r"\s+")
+
+# Locale-sensitive lower-casing: Java's String.toLowerCase(Locale) differs
+# from the root locale only for Turkish/Azerbaijani (dotted/dotless i) and
+# Lithuanian (dot retention, which Python's str.lower matches closely enough
+# for byte-profile purposes). The reference derives the locale from the
+# row's *label* column (LowerCasePreprocessor.scala:60) — usable only on
+# labeled training data; we mirror that.
+_TURKIC = {"tr", "az"}
+
+
+def _lower_locale(text: str, lang_tag: str) -> str:
+    base = lang_tag.split("-")[0].lower() if lang_tag else ""
+    if base in _TURKIC:
+        # Java tr/az rules: I → ı, İ → i (combining-dot subtleties aside).
+        text = text.replace("İ", "i").replace("I", "ı")
+    return text.lower()
+
+
+class _InPlaceColumnTransformer(HasOutputCol):
+    """Shared shape: read ``outputCol``, rewrite it, move it last."""
+
+    def set_input_col(self, value: str):
+        # Reference quirk Q8: setInputCol sets outputCol
+        # (LowerCasePreprocessor.scala:32, SpecialCharPreprocessor.scala:30).
+        return self.set("outputCol", value)
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        col = self.get_output_col()
+        if col in schema:
+            schema = schema.drop(col)
+        return schema.append(col, STRING, nullable=True)
+
+    def copy(self, extra=None):
+        return super().copy(extra)
+
+
+class LowerCasePreprocessor(_InPlaceColumnTransformer, HasLabelCol):
+    """Locale-aware lower-casing using the row's label as locale tag.
+
+    Reference: ``LowerCasePreprocessor`` (LowerCasePreprocessor.scala:19-77).
+    """
+
+    def __init__(self, uid: str | None = None):
+        super().__init__(uid, uid_prefix="LowerCasePreprocessor")
+        self.set_default(outputCol="fulltext", labelCol="lang")
+
+    def transform(self, dataset: Table) -> Table:
+        col, label_col = self.get_output_col(), self.get_label_col()
+        texts = dataset.column(col)
+        labels = dataset.column(label_col)
+        lowered = [_lower_locale(t, l) for t, l in zip(texts, labels)]
+        return dataset.replace_column(col, lowered, STRING)
+
+
+class SpecialCharPreprocessor(_InPlaceColumnTransformer):
+    """Strip symbols and squash whitespace runs to a single space.
+
+    Reference: ``SpecialCharPreprocessor`` (SpecialCharPreprocessor.scala:19-71),
+    implementing its *intended* behavior (its own regex is invalid — Q3/Q4).
+    """
+
+    def __init__(self, uid: str | None = None):
+        super().__init__(uid, uid_prefix="SpecialCharPreprocessor")
+        self.set_default(outputCol="fulltext")
+
+    def transform(self, dataset: Table) -> Table:
+        col = self.get_output_col()
+        texts = dataset.column(col)
+        cleaned = [
+            _WHITESPACE_RE.sub(" ", _SYMBOL_RE.sub("", t)) for t in texts
+        ]
+        return dataset.replace_column(col, cleaned, STRING)

@@ -1,0 +1,121 @@
+"""GramProfile: the trained model state, host + device views.
+
+The reference's model state is ``Map[Seq[Byte], Array[Double]]`` — a JVM map
+from gram bytes to per-language log-weights
+(``/root/reference/src/main/.../LanguageDetectorModel.scala:179``). The
+TPU-native state is columnar: a sorted id vector plus a dense weight matrix
+(exact mode), or just a dense ``[V, L]`` bucket table (hashed mode). The map
+view is still offered for API/test parity (``gram_probabilities``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import cached_property
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.vocab import EXACT, HASHED, VocabSpec
+
+
+@dataclass(frozen=True)
+class GramProfile:
+    """Immutable trained profile.
+
+    ``ids``: int64 [G] ascending gram ids (exact mode; empty for hashed).
+    ``weights``: float [G, L] (exact) or [V, L] (hashed) — no miss row; the
+    scoring-time zeros row is appended in the device view.
+    ``languages``: decision order — index i ⇒ ``languages[i]`` (the reference's
+    ``supportedLanguages(argmax)``).
+    """
+
+    spec: VocabSpec
+    languages: tuple[str, ...]
+    ids: np.ndarray
+    weights: np.ndarray
+
+    def __post_init__(self):
+        if self.spec.mode == EXACT:
+            if self.ids.shape[0] != self.weights.shape[0]:
+                raise ValueError(
+                    f"ids/weights mismatch: {self.ids.shape} vs {self.weights.shape}"
+                )
+            if len(self.ids) > 1 and not bool(np.all(np.diff(self.ids) > 0)):
+                raise ValueError("exact profile ids must be strictly ascending")
+        else:
+            if self.weights.shape[0] != self.spec.id_space_size:
+                raise ValueError(
+                    f"hashed weights must have {self.spec.id_space_size} rows, "
+                    f"got {self.weights.shape[0]}"
+                )
+        if self.weights.shape[1] != len(self.languages):
+            raise ValueError(
+                f"weights have {self.weights.shape[1]} columns for "
+                f"{len(self.languages)} languages"
+            )
+
+    @property
+    def num_languages(self) -> int:
+        return len(self.languages)
+
+    @property
+    def num_grams(self) -> int:
+        return int(self.ids.shape[0]) if self.spec.mode == EXACT else int(
+            self.weights.shape[0]
+        )
+
+    # -- device view -----------------------------------------------------------
+    def device_arrays(self, dtype=jnp.float32) -> tuple[jnp.ndarray, jnp.ndarray | None]:
+        """(weights_dev, sorted_ids_dev) ready for ``ops.score.score_batch``.
+
+        Exact mode appends the zeros miss-row; ids go to int32 (the exact id
+        space is ≤ 2^25, int32-safe by VocabSpec's construction).
+        """
+        if self.spec.mode == EXACT:
+            w = np.concatenate(
+                [self.weights, np.zeros((1, self.num_languages), self.weights.dtype)]
+            )
+            return (
+                jnp.asarray(w, dtype=dtype),
+                jnp.asarray(self.ids.astype(np.int32)),
+            )
+        return jnp.asarray(self.weights, dtype=dtype), None
+
+    # -- map view (reference API parity) --------------------------------------
+    @cached_property
+    def gram_probabilities(self) -> dict[bytes, np.ndarray]:
+        """``Map[gram bytes → weight vector]`` — exact mode only."""
+        if self.spec.mode != EXACT:
+            raise ValueError(
+                "hashed profiles store bucket weights, not gram byte maps"
+            )
+        return {
+            self.spec.id_to_gram(int(i)): self.weights[r]
+            for r, i in enumerate(self.ids)
+        }
+
+    @staticmethod
+    def from_gram_map(
+        gram_map: dict[bytes, "np.ndarray | list[float]"],
+        languages: tuple[str, ...] | list[str],
+        gram_lengths: tuple[int, ...] | list[int],
+    ) -> "GramProfile":
+        """Build an exact profile from a hand-written gram→weights map — the
+        reference tests' oracle pattern (LanguageDetectorModelSpecs.scala:26-35).
+        """
+        spec = VocabSpec(EXACT, tuple(gram_lengths))
+        items = sorted(
+            ((spec.gram_to_id(g), np.asarray(w, dtype=np.float64)) for g, w in gram_map.items()),
+            key=lambda kv: kv[0],
+        )
+        ids = np.asarray([i for i, _ in items], dtype=np.int64)
+        L = len(languages)
+        weights = (
+            np.stack([w for _, w in items])
+            if items
+            else np.zeros((0, L), dtype=np.float64)
+        )
+        return GramProfile(
+            spec=spec, languages=tuple(languages), ids=ids, weights=weights
+        )

@@ -1,0 +1,108 @@
+"""Text → fixed-shape byte tensors (host side, vectorized numpy).
+
+The reference streams each document's bytes through Scala iterators
+(``/root/reference/src/main/.../LanguageDetector.scala:36-43``,
+``LanguageDetectorModel.scala:139-152``). XLA needs static shapes, so the
+TPU-native front door is: encode each text to bytes, then pack a micro-batch
+into a zero-padded ``uint8 [B, S]`` array plus an ``int32 [B]`` length vector
+(SURVEY.md §7.4 "fixed shapes vs ragged text"). Padding is 0x00; validity is
+carried by the length vector, never by sentinel bytes.
+
+Two string→bytes encodings exist because the reference has a train/predict
+encoding mismatch (SURVEY.md §2.9 Q2): fit uses UTF-8 while predict truncates
+UTF-16 code units to their low byte. ``utf8`` is this framework's default for
+both paths; ``low_byte`` exists so parity mode can reproduce the reference's
+predict path bit-for-bit.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+UTF8 = "utf8"
+LOW_BYTE = "low_byte"
+ENCODINGS = (UTF8, LOW_BYTE)
+
+
+def text_to_bytes(text: str, encoding: str = UTF8) -> bytes:
+    if encoding == UTF8:
+        return text.encode("utf-8")
+    if encoding == LOW_BYTE:
+        # Reference predict path: text.toCharArray.map(_.toByte)
+        # (LanguageDetectorModel.scala:161) — low byte of each UTF-16 unit.
+        units = text.encode("utf-16-le")
+        return units[::2]
+    raise ValueError(f"unknown encoding {encoding!r}; expected one of {ENCODINGS}")
+
+
+def texts_to_bytes(texts: Sequence[str], encoding: str = UTF8) -> list[bytes]:
+    return [text_to_bytes(t, encoding) for t in texts]
+
+
+def bucket_length(length: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket ≥ length; buckets sorted asc.
+
+    Bucketed padded shapes keep XLA compile counts bounded: every micro-batch
+    compiles at one of a small set of [B, S] shapes. A document longer than
+    the largest bucket gets a power-of-two bucket that covers it — padding
+    never silently truncates (explicit ``pad_to`` is the only truncating
+    path, used by the runner after chunking long docs).
+    """
+    for b in buckets:
+        if length <= b:
+            return b
+    width = buckets[-1]
+    while width < length:
+        width *= 2
+    return width
+
+
+DEFAULT_LENGTH_BUCKETS: tuple[int, ...] = (128, 512, 2048, 8192)
+
+
+def pad_batch(
+    byte_docs: Sequence[bytes],
+    pad_to: int | None = None,
+    length_buckets: Sequence[int] | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Pack variable-length byte strings into (uint8 [B, S], int32 lengths [B]).
+
+    Documents longer than the padded width are truncated (callers that need
+    unbounded documents chunk first — see ``parallel/sequence.py``).
+    """
+    lengths = np.fromiter((len(d) for d in byte_docs), dtype=np.int32, count=len(byte_docs))
+    max_len = int(lengths.max()) if len(byte_docs) else 1
+    max_len = max(max_len, 1)
+    if pad_to is None:
+        buckets = length_buckets or DEFAULT_LENGTH_BUCKETS
+        pad_to = bucket_length(max_len, buckets)
+    batch = np.zeros((len(byte_docs), pad_to), dtype=np.uint8)
+    for i, doc in enumerate(byte_docs):
+        n = min(len(doc), pad_to)
+        if n:
+            batch[i, :n] = np.frombuffer(doc, dtype=np.uint8, count=n)
+    np.minimum(lengths, pad_to, out=lengths)
+    return batch, lengths
+
+
+def chunk_document(
+    doc: bytes, chunk_size: int, overlap: int
+) -> list[bytes]:
+    """Split one long document into fixed-size chunks with ``overlap`` shared
+    bytes between consecutive chunks (``overlap = max(gram_lengths) - 1``), so
+    every sliding window of the original document is fully contained in some
+    chunk (SURVEY.md §5.7). To count each window exactly once, a non-final
+    chunk owns window starts ``[0, chunk_size - overlap)`` and the final chunk
+    owns all of its window starts — enforced by the scorer's per-chunk window
+    masks. The doc's gram histogram is then the sum of per-chunk histograms
+    (associative ⇒ chunks may land on different devices and combine with a
+    psum — the ring-attention analog for bag-of-grams scoring).
+    """
+    if chunk_size <= overlap:
+        raise ValueError(f"chunk_size {chunk_size} must exceed overlap {overlap}")
+    if len(doc) <= chunk_size:
+        return [doc]
+    stride = chunk_size - overlap
+    return [doc[start : start + chunk_size] for start in range(0, len(doc) - overlap, stride)]

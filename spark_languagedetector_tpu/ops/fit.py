@@ -1,0 +1,163 @@
+"""Profile fitting: gram counting → weighting → per-language top-k.
+
+Host (vectorized numpy) implementation of the reference's four training stages
+(``/root/reference/src/main/.../LanguageDetector.scala``):
+
+  computeGrams (:25-46)  → :func:`extract_gram_counts` — one padded-batch pass
+  reduceGrams (:52-66)   →   (same pass; np.unique over (id, lang) replaces
+                              |langs| shuffles — fixes SURVEY.md §2.9 Q9)
+  computeProbabilities (:75-92) → :func:`compute_weights`
+  filterTopGrams (:100-132)     → :func:`select_top_grams`
+
+Weighting has two modes (SURVEY.md §2.9 Q1):
+  * ``parity``: the reference's actual formula — occurrence counts are
+    discarded and weight_l = log(1 + present_l / #langs_containing_gram),
+    a cross-language uniqueness weight.
+  * ``counts``: the formula the reference's README/docstrings *claim* —
+    weight_l = log(1 + count_l / total_count) — behind an explicit flag.
+
+The device-side (TPU, mesh-sharded) fit lives in ``fit_tpu.py``; both produce
+the same profile arrays and are cross-checked by tests.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import numpy as np
+
+from .encoding import pad_batch
+from .vocab import EXACT, VocabSpec, short_doc_ids_numpy, window_ids_numpy
+
+PARITY = "parity"
+COUNTS = "counts"
+WEIGHT_MODES = (PARITY, COUNTS)
+
+_FIT_BATCH = 1024  # docs per padded counting batch
+
+
+@dataclass(frozen=True)
+class GramCounts:
+    """Sparse per-(gram, language) totals: the reduceGrams output."""
+
+    ids: np.ndarray  # int64 [M] gram ids, ascending
+    langs: np.ndarray  # int32 [M] language indices
+    counts: np.ndarray  # int64 [M] total occurrences
+    num_langs: int
+
+
+def extract_gram_counts(
+    byte_docs: Sequence[bytes],
+    lang_indices: np.ndarray,
+    num_langs: int,
+    spec: VocabSpec,
+    batch_size: int = _FIT_BATCH,
+) -> GramCounts:
+    """Count every window occurrence per (gram id, language).
+
+    One padded-batch sweep over the corpus; all languages aggregate in a single
+    pass (the reference launches per-language Spark jobs — Q9). Partial windows
+    of short documents are included, mirroring Scala ``sliding``.
+    """
+    lang_indices = np.asarray(lang_indices, dtype=np.int64)
+    max_n = max(spec.gram_lengths)
+    pair_chunks: list[np.ndarray] = []
+
+    for start in range(0, len(byte_docs), batch_size):
+        docs = byte_docs[start : start + batch_size]
+        langs = lang_indices[start : start + batch_size]
+        batch, lengths = pad_batch(docs, pad_to=max(max(len(d) for d in docs), 1))
+        for n in spec.gram_lengths:
+            ids = window_ids_numpy(batch, n, spec)  # [B, W]
+            W = ids.shape[1]
+            mask = np.arange(W)[None, :] <= (lengths[:, None] - n)
+            lang_grid = np.broadcast_to(langs[:, None], ids.shape)
+            pair_chunks.append(
+                ids[mask] * num_langs + lang_grid[mask]
+            )
+        # Partial windows for docs shorter than some gram length.
+        for i, doc in enumerate(docs):
+            if len(doc) < max_n:
+                short = short_doc_ids_numpy(doc, spec)
+                if short:
+                    pair_chunks.append(
+                        np.asarray(short, dtype=np.int64) * num_langs + langs[i]
+                    )
+
+    if not pair_chunks:
+        return GramCounts(
+            np.zeros(0, np.int64), np.zeros(0, np.int32), np.zeros(0, np.int64), num_langs
+        )
+    pairs = np.concatenate(pair_chunks)
+    unique_pairs, counts = np.unique(pairs, return_counts=True)
+    return GramCounts(
+        ids=unique_pairs // num_langs,
+        langs=(unique_pairs % num_langs).astype(np.int32),
+        counts=counts.astype(np.int64),
+        num_langs=num_langs,
+    )
+
+
+def compute_weights(
+    gram_counts: GramCounts, weight_mode: str = PARITY
+) -> tuple[np.ndarray, np.ndarray]:
+    """Per-gram weight vectors.
+
+    Returns (unique_ids [U] ascending, weights [U, L] float64).
+    """
+    if weight_mode not in WEIGHT_MODES:
+        raise ValueError(f"weight_mode must be one of {WEIGHT_MODES}")
+    L = gram_counts.num_langs
+    unique_ids, row_index = np.unique(gram_counts.ids, return_inverse=True)
+    U = len(unique_ids)
+    weights = np.zeros((U, L), dtype=np.float64)
+    if weight_mode == PARITY:
+        # #langs containing each gram; each (id, lang) appears exactly once.
+        nlangs = np.bincount(row_index, minlength=U).astype(np.float64)
+        weights[row_index, gram_counts.langs] = np.log1p(1.0 / nlangs[row_index])
+    else:
+        totals = np.zeros(U, dtype=np.float64)
+        np.add.at(totals, row_index, gram_counts.counts.astype(np.float64))
+        weights[row_index, gram_counts.langs] = np.log1p(
+            gram_counts.counts / totals[row_index]
+        )
+    return unique_ids, weights
+
+
+def select_top_grams(
+    unique_ids: np.ndarray,
+    weights: np.ndarray,
+    profile_size: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Keep the union over languages of each language's top-k grams.
+
+    Reference semantics (LanguageDetector.scala:100-132): per language, sort
+    *all* grams by that language's weight descending, take k, union the winner
+    sets, and keep the full weight vector of every winner. Ties break by gram
+    id ascending (deterministic; the reference's order under Spark is
+    partition-dependent). Duplicate winners collapse (Q7's implicit dedupe).
+    """
+    L = weights.shape[1]
+    k = min(profile_size, len(unique_ids))
+    winner_rows: list[np.ndarray] = []
+    for l in range(L):
+        # lexsort: last key primary → primary -weight, secondary id ascending.
+        order = np.lexsort((unique_ids, -weights[:, l]))[:k]
+        winner_rows.append(order)
+    rows = np.unique(np.concatenate(winner_rows)) if winner_rows else np.zeros(0, np.int64)
+    return unique_ids[rows], np.ascontiguousarray(weights[rows])
+
+
+def fit_profile_numpy(
+    byte_docs: Sequence[bytes],
+    lang_indices: np.ndarray,
+    num_langs: int,
+    spec: VocabSpec,
+    profile_size: int,
+    weight_mode: str = PARITY,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Full host fit: returns (sorted gram ids [G], weights [G, L] float64)."""
+    gram_counts = extract_gram_counts(byte_docs, lang_indices, num_langs, spec)
+    unique_ids, weights = compute_weights(gram_counts, weight_mode)
+    return select_top_grams(unique_ids, weights, profile_size)

@@ -1,0 +1,107 @@
+"""Device-side fit: dense gram counting + weighting + top-k, jit-compiled.
+
+The host fit (``fit.py``) is exact and fast for corpora that fit one host.
+This module is the *device* fit step for the distributed path (SURVEY.md §5.8,
+§7.2 "dist"): counts accumulate as a dense ``[V, L]`` table by scatter-add, so
+multiple data shards combine with a single ``psum`` over the data axis and the
+table itself can shard over a model axis (`parallel/fit_sharded.py` wires the
+mesh; this module is mesh-agnostic math).
+
+Dense tables want a bounded id space: hashed vocabs (any gram lengths) or
+exact vocabs with max length ≤ 2 use this path end-to-end; exact trigram
+(V ≈ 16.8M) still works on a real chip but tests keep V small.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .vocab import VocabSpec, partial_window_ids, window_ids
+
+
+@partial(jax.jit, static_argnames=("spec", "num_langs"))
+def gram_counts_dense(
+    batch: jnp.ndarray,
+    lengths: jnp.ndarray,
+    lang_ids: jnp.ndarray,
+    *,
+    spec: VocabSpec,
+    num_langs: int,
+) -> jnp.ndarray:
+    """Count windows per (gram id, language) for one padded batch.
+
+    Args:
+      batch: uint8 [B, S]; lengths: int32 [B]; lang_ids: int32 [B].
+    Returns:
+      int32 [V, L] occurrence counts (dense; V = spec.id_space_size).
+    """
+    B, S = batch.shape
+    V = spec.id_space_size
+    counts = jnp.zeros((V, num_langs), dtype=jnp.int32)
+    for n in spec.gram_lengths:
+        W = max(S - n + 1, 1)
+        ids = window_ids(batch, n, spec)
+        starts = jnp.arange(W, dtype=jnp.int32)[None, :]
+        mask = starts <= (lengths[:, None] - n)
+        # Partial window of short docs (Scala sliding parity; shared helper).
+        short_ids = partial_window_ids(batch, lengths, n, ids[:, 0], spec)
+        is_short = lengths < n
+        ids = ids.at[:, 0].set(jnp.where(is_short, short_ids, ids[:, 0]))
+        mask = mask.at[:, 0].set(mask[:, 0] | (is_short & (lengths > 0)))
+
+        # 2-D scatter (row = gram id, col = language) keeps indices int32-safe
+        # for any V × L (a flattened V*L index overflows int32 at CLD2 scale).
+        # Masked windows scatter a zero update into (0, lang) — harmless.
+        rows = jnp.where(mask, ids, 0).reshape(-1)
+        cols = jnp.broadcast_to(lang_ids[:, None], ids.shape).reshape(-1)
+        updates = mask.astype(jnp.int32).reshape(-1)
+        counts = counts.at[rows, cols].add(updates)
+    return counts
+
+
+@partial(jax.jit, static_argnames=("weight_mode",))
+def weights_from_counts(counts: jnp.ndarray, *, weight_mode: str = "parity") -> jnp.ndarray:
+    """Dense [V, L] counts → dense [V, L] float32 weights.
+
+    parity: log1p(present / #langs containing) — reference formula (Q1).
+    counts: log1p(count / total occurrences of the gram).
+    """
+    present = counts > 0
+    if weight_mode == "parity":
+        nlangs = present.sum(axis=1, keepdims=True)
+        ratio = jnp.where(nlangs > 0, present / jnp.maximum(nlangs, 1), 0.0)
+    else:
+        totals = counts.sum(axis=1, keepdims=True)
+        ratio = jnp.where(totals > 0, counts / jnp.maximum(totals, 1), 0.0)
+    return jnp.log1p(ratio.astype(jnp.float32))
+
+
+@partial(jax.jit, static_argnames=("k",))
+def top_k_rows(weights: jnp.ndarray, *, k: int) -> jnp.ndarray:
+    """Per-language top-k row indices over the dense table: int32 [L, k].
+
+    ``lax.top_k`` breaks ties by lowest index — deterministic, and documented
+    as this framework's tie rule (the reference's tie order is
+    partition-dependent, SURVEY.md §2.9).
+    """
+    _, idx = jax.lax.top_k(weights.T, k)  # [L, k]
+    return idx.astype(jnp.int32)
+
+
+def fit_dense_step(
+    batch: jnp.ndarray,
+    lengths: jnp.ndarray,
+    lang_ids: jnp.ndarray,
+    counts_acc: jnp.ndarray,
+    *,
+    spec: VocabSpec,
+    num_langs: int,
+) -> jnp.ndarray:
+    """One accumulation step: counts_acc += counts(batch). Streaming fit over
+    micro-batches keeps HBM bounded regardless of corpus size."""
+    return counts_acc + gram_counts_dense(
+        batch, lengths, lang_ids, spec=spec, num_langs=num_langs
+    )

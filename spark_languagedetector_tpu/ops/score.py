@@ -1,0 +1,198 @@
+"""Batch scoring: padded byte batches → per-language scores → argmax.
+
+Replaces the reference's per-row hot loop — per-window JVM hash-map lookup +
+``BLAS.axpy`` accumulate + Breeze argmax
+(``/root/reference/src/main/.../LanguageDetectorModel.scala:131-156``) — with a
+fixed-shape, jit-compiled pipeline:
+
+    bytes [B, S] ──window_ids──▶ ids [B, W] ──membership──▶ rows [B, W]
+      ──gather W[rows] · mask, block-scan──▶ scores [B, L] ──argmax──▶ [B]
+
+Exact mode resolves membership with a branchless binary search against the
+model's sorted id vector (misses hit a zeros row). Hashed mode indexes the
+dense ``[V, L]`` weight table directly. The window axis is processed in
+blocks under ``lax.scan`` so peak memory is ``B·block·L`` regardless of
+document length, and XLA fuses the gather+mask+reduce per block.
+
+Semantics parity (SURVEY.md §2.9): unknown grams contribute zero; an all-miss
+document scores all-zeros and argmax resolves to index 0 — the reference's Q6
+behavior; ties resolve to the lowest index (Breeze and ``jnp.argmax`` both
+return the first maximum). Documents shorter than a gram length contribute one
+partial window per configured length, exactly like Scala ``sliding``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .vocab import EXACT, HASHED, VocabSpec, partial_window_ids, window_ids
+
+# Default window-axis block for the scan; multiple of 128 lanes.
+DEFAULT_BLOCK = 1024
+
+
+def _lookup_rows_exact(ids: jnp.ndarray, sorted_ids: jnp.ndarray) -> jnp.ndarray:
+    """ids [B, W] int32 → row indices into the weight matrix [G+1, L].
+
+    Binary search + equality check; misses map to row G (the zeros row).
+    An empty profile (G == 0) maps everything to the miss row.
+    """
+    G = sorted_ids.shape[0]
+    if G == 0:
+        return jnp.zeros_like(ids)
+    pos = jnp.searchsorted(sorted_ids, ids, side="left").astype(jnp.int32)
+    pos_c = jnp.minimum(pos, G - 1)
+    hit = sorted_ids[pos_c] == ids
+    return jnp.where(hit, pos_c, G)
+
+
+def _partial_window_rows(
+    batch: jnp.ndarray,
+    lengths: jnp.ndarray,
+    n: int,
+    window0_ids: jnp.ndarray,
+    spec: VocabSpec,
+    sorted_ids: jnp.ndarray | None,
+    miss_row: int,
+) -> jnp.ndarray:
+    """Row indices for the single partial window of docs with len < n.
+    Docs with len == 0 get the miss row (Scala ``sliding`` over an empty
+    collection emits nothing)."""
+    short_ids = partial_window_ids(batch, lengths, n, window0_ids, spec)
+    if spec.mode == EXACT:
+        rows = _lookup_rows_exact(short_ids[:, None], sorted_ids)[:, 0]
+    else:
+        rows = short_ids
+    return jnp.where(lengths > 0, rows, miss_row)
+
+
+def _block_accumulate(
+    weights: jnp.ndarray, rows: jnp.ndarray, mask: jnp.ndarray, block: int
+) -> jnp.ndarray:
+    """Σ_w weights[rows[b, w]] · mask[b, w] → [B, L], scanned in window blocks."""
+    B, W = rows.shape
+    L = weights.shape[1]
+    pad = (-W) % block
+    if pad:
+        rows = jnp.pad(rows, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+    nblk = rows.shape[1] // block
+    rows = rows.reshape(B, nblk, block).transpose(1, 0, 2)
+    mask = mask.reshape(B, nblk, block).transpose(1, 0, 2)
+
+    def body(acc, blk):
+        r, m = blk
+        contrib = weights[r] * m[..., None].astype(weights.dtype)
+        return acc + contrib.sum(axis=1).astype(jnp.float32), None
+
+    init = jnp.zeros((B, L), dtype=jnp.float32)
+    acc, _ = jax.lax.scan(body, init, (rows, mask))
+    return acc
+
+
+@partial(jax.jit, static_argnames=("spec", "block"))
+def score_batch(
+    batch: jnp.ndarray,
+    lengths: jnp.ndarray,
+    weights: jnp.ndarray,
+    sorted_ids: jnp.ndarray | None,
+    *,
+    spec: VocabSpec,
+    block: int = DEFAULT_BLOCK,
+    window_limit: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Scores for a padded batch.
+
+    Args:
+      batch: uint8 [B, S] zero-padded document bytes.
+      lengths: int32 [B] true byte lengths (≤ S).
+      weights: float [G+1, L] (exact; row G zeros) or [V, L] (hashed).
+      sorted_ids: int32 [G] ascending gram ids (exact mode) or None.
+      spec: vocabulary spec (static — hashable frozen dataclass).
+      block: window-axis scan block size.
+      window_limit: optional int32 [B] — row i only counts window starts
+        < window_limit[i]. Used for long-document chunking: a non-final chunk
+        owns starts [0, chunk_size - overlap); the final chunk owns all
+        (see ``ops.encoding.chunk_document``). None ⇒ no limit.
+
+    Returns:
+      float32 [B, L] accumulated per-language scores.
+    """
+    B, S = batch.shape
+    L = weights.shape[1]
+    miss_row = weights.shape[0] - 1 if spec.mode == EXACT else 0
+    total = jnp.zeros((B, L), dtype=jnp.float32)
+    for n in spec.gram_lengths:
+        W = max(S - n + 1, 1)
+        ids = window_ids(batch, n, spec)  # [B, W]
+        if spec.mode == EXACT:
+            rows = _lookup_rows_exact(ids, sorted_ids)
+        else:
+            rows = ids
+        starts = jnp.arange(W, dtype=jnp.int32)[None, :]
+        mask = starts <= (lengths[:, None] - n)  # full windows only
+        if window_limit is not None:
+            mask = mask & (starts < window_limit[:, None])
+        # Partial-window rule for docs shorter than n (Scala sliding parity).
+        partial_rows = _partial_window_rows(
+            batch, lengths, n, ids[:, 0], spec, sorted_ids, miss_row
+        )
+        is_short = lengths < n
+        rows = rows.at[:, 0].set(jnp.where(is_short, partial_rows, rows[:, 0]))
+        mask = mask.at[:, 0].set(mask[:, 0] | (is_short & (lengths > 0)))
+        if spec.mode == HASHED:
+            # Hashed mode has no zeros row; masked gathers still index row 0,
+            # so the mask multiply inside the block scan is what zeroes them.
+            rows = jnp.where(mask, rows, 0)
+        total = total + _block_accumulate(weights, rows, mask, block)
+    return total
+
+
+def argmax_language(scores: jnp.ndarray) -> jnp.ndarray:
+    """[B, L] → int32 [B]; first maximum wins (reference tie/zero behavior)."""
+    return jnp.argmax(scores, axis=1).astype(jnp.int32)
+
+
+# --- numpy mirror (used by the CPU backend and as a test oracle bridge) ------
+
+
+def score_batch_numpy(
+    byte_docs: list[bytes],
+    weights: np.ndarray,
+    sorted_ids: np.ndarray | None,
+    spec: VocabSpec,
+) -> np.ndarray:
+    """Vectorized host scorer with identical semantics (no padding needed)."""
+    from .vocab import short_doc_ids_numpy, window_ids_numpy
+
+    L = weights.shape[1]
+    out = np.zeros((len(byte_docs), L), dtype=np.float64)
+    for i, doc in enumerate(byte_docs):
+        arr = np.frombuffer(doc, dtype=np.uint8)[None, :]
+        acc = np.zeros((L,), dtype=np.float64)
+        ids_all = []
+        for n in spec.gram_lengths:
+            if len(doc) >= n:
+                ids_all.append(window_ids_numpy(arr, n, spec)[0])
+        short = short_doc_ids_numpy(doc, spec)
+        if short:
+            ids_all.append(np.asarray(short, dtype=np.int64))
+        if ids_all:
+            ids = np.concatenate(ids_all)
+            if spec.mode == EXACT:
+                if len(sorted_ids) == 0:
+                    rows = np.full(len(ids), weights.shape[0] - 1)
+                else:
+                    pos = np.searchsorted(sorted_ids, ids)
+                    pos_c = np.minimum(pos, len(sorted_ids) - 1)
+                    hit = sorted_ids[pos_c] == ids
+                    rows = np.where(hit, pos_c, weights.shape[0] - 1)
+                acc += weights[rows].sum(axis=0)
+            else:
+                acc += weights[ids].sum(axis=0)
+        out[i] = acc
+    return out

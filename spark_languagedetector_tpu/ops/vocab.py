@@ -1,0 +1,251 @@
+"""Gram vocabulary: byte n-grams ↔ integer ids, exact and hashed modes.
+
+The reference keys its model on raw byte sequences in a JVM hash map
+(``Map[Seq[Byte], Array[Double]]``, ``LanguageDetectorModel.scala:132``) and
+looks every sliding window up per-row (``:139-152``). There is no TPU analog of
+a pointer-chasing hash map (SURVEY.md §7.4 "vocab on device"), so grams become
+integers:
+
+- **EXACT** mode (parity): a gram of length n maps bijectively to
+  ``offset(n) + poly(bytes)`` where ``poly`` is the big-endian base-256
+  polynomial value and ``offset(n)`` stacks the id spaces of the configured
+  gram lengths disjointly. Device-side membership is a binary search over the
+  model's sorted id vector. Exact mode supports ``max(gram_lengths) <= 3``
+  (id space must fit int32 for TPU-friendly integer ops); longer grams use
+  hashed mode, matching BASELINE's configs (exact n≤3, hashed n=1..5).
+
+- **HASHED** mode (fastText-lid-style): FNV-1a over the window bytes folded
+  into ``2**bits`` buckets. Collisions merge grams (accuracy impact measured
+  by the parity benchmarks, not assumed); scale is unbounded.
+
+All id arithmetic is vectorized numpy on host and jnp on device; the two
+implementations are kept in lockstep by tests.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+EXACT = "exact"
+HASHED = "hashed"
+
+MAX_EXACT_GRAM_LEN = 3
+
+# FNV-1a 32-bit constants.
+_FNV_OFFSET = np.uint32(2166136261)
+_FNV_PRIME = np.uint32(16777619)
+
+
+def exact_offsets(gram_lengths: Sequence[int]) -> dict[int, int]:
+    """Disjoint id-space offsets for every gram length 1..max(gram_lengths).
+
+    All lengths below the max get a slot (not just the configured ones)
+    because the reference's ``sliding`` emits a *partial* window for documents
+    shorter than the gram length — in fit (LanguageDetector.scala:39) and in
+    predict (LanguageDetectorModel.scala:143) — so grams shorter than any
+    configured length can be learned and matched.
+    """
+    offsets: dict[int, int] = {}
+    acc = 0
+    for n in range(1, max(gram_lengths) + 1):
+        offsets[n] = acc
+        acc += 256**n
+    return offsets
+
+
+def exact_space_size(gram_lengths: Sequence[int]) -> int:
+    return sum(256**n for n in range(1, max(gram_lengths) + 1))
+
+
+@dataclass(frozen=True)
+class VocabSpec:
+    """How window bytes become integer gram ids.
+
+    ``mode``: EXACT or HASHED.
+    ``gram_lengths``: window sizes, ascending, deduplicated.
+    ``hash_bits``: log2 of bucket count (HASHED only).
+    """
+
+    mode: str
+    gram_lengths: tuple[int, ...]
+    hash_bits: int = 20
+
+    def __post_init__(self):
+        if self.mode not in (EXACT, HASHED):
+            raise ValueError(f"unknown vocab mode {self.mode!r}")
+        glens = tuple(sorted(set(int(n) for n in self.gram_lengths)))
+        if not glens or glens[0] < 1:
+            raise ValueError(f"gram lengths must be >= 1, got {self.gram_lengths}")
+        object.__setattr__(self, "gram_lengths", glens)
+        if self.mode == EXACT and glens[-1] > MAX_EXACT_GRAM_LEN:
+            raise ValueError(
+                f"exact vocab supports gram lengths <= {MAX_EXACT_GRAM_LEN} "
+                f"(id space must fit int32); got {glens}. Use mode='hashed'."
+            )
+        if self.mode == HASHED and not (1 <= self.hash_bits <= 30):
+            raise ValueError(f"hash_bits must be in [1, 30], got {self.hash_bits}")
+
+    @property
+    def id_space_size(self) -> int:
+        """Total dense id space (exact) or bucket count (hashed)."""
+        if self.mode == EXACT:
+            return exact_space_size(self.gram_lengths)
+        return 1 << self.hash_bits
+
+    @property
+    def offsets(self) -> dict[int, int]:
+        if self.mode != EXACT:
+            raise ValueError("offsets only exist for exact vocabs")
+        return exact_offsets(self.gram_lengths)
+
+    # -- host-side gram ↔ id (exact mode) -------------------------------------
+    def gram_to_id(self, gram: bytes) -> int:
+        if self.mode == EXACT:
+            n = len(gram)
+            if n not in self.offsets:
+                raise ValueError(
+                    f"gram length {n} outside 1..{max(self.gram_lengths)}"
+                )
+            value = 0
+            for b in gram:
+                value = value * 256 + b
+            return self.offsets[n] + value
+        h = int(_FNV_OFFSET)
+        for b in gram:
+            h = ((h ^ b) * int(_FNV_PRIME)) & 0xFFFFFFFF
+        return h & ((1 << self.hash_bits) - 1)
+
+    def id_to_gram(self, gram_id: int) -> bytes:
+        """Inverse mapping — exact mode only (hashed buckets are lossy)."""
+        if self.mode != EXACT:
+            raise ValueError("hashed vocab ids cannot be decoded to bytes")
+        offsets = self.offsets
+        for n in sorted(offsets, reverse=True):
+            if gram_id >= offsets[n]:
+                value = gram_id - offsets[n]
+                out = bytearray(n)
+                for i in range(n - 1, -1, -1):
+                    out[i] = value % 256
+                    value //= 256
+                return bytes(out)
+        raise ValueError(f"gram id {gram_id} below all offsets")
+
+
+# --- window id computation (numpy host / jnp device, kept in lockstep) -------
+
+
+def window_ids_numpy(batch: np.ndarray, n: int, spec: VocabSpec) -> np.ndarray:
+    """Ids of all n-windows of ``batch`` (uint8 [B, S]) → int64/uint32 [B, S-n+1].
+
+    Host mirror of :func:`window_ids` used by the numpy fit path and tests.
+    Validity masking is the caller's job.
+    """
+    B, S = batch.shape
+    if S < n:  # batch narrower than the window: zero-extend (padding bytes)
+        batch = np.pad(batch, ((0, 0), (0, n - S)))
+        S = n
+    W = S - n + 1
+    if spec.mode == EXACT:
+        ids = np.zeros((B, W), dtype=np.int64)
+        for i in range(n):
+            ids = ids * 256 + batch[:, i : i + W].astype(np.int64)
+        return ids + spec.offsets[n]
+    h = np.full((B, W), _FNV_OFFSET, dtype=np.uint32)
+    for i in range(n):
+        h = (h ^ batch[:, i : i + W].astype(np.uint32)) * _FNV_PRIME
+    return (h & np.uint32((1 << spec.hash_bits) - 1)).astype(np.int64)
+
+
+def window_ids(batch: jnp.ndarray, n: int, spec: VocabSpec) -> jnp.ndarray:
+    """Device-side window ids: uint8 [B, S] → int32 [B, S-n+1].
+
+    Shifted-slice formulation (no gather): the n byte planes of each window are
+    just n static slices of the batch, combined with the per-mode mixing
+    arithmetic. XLA fuses this to a handful of vector ops — this op replaces
+    the reference's per-window ``Map.get`` (LanguageDetectorModel.scala:145).
+    """
+    B, S = batch.shape
+    if S < n:  # batch narrower than the window: zero-extend (padding bytes)
+        batch = jnp.pad(batch, ((0, 0), (0, n - S)))
+        S = n
+    W = S - n + 1
+    if spec.mode == EXACT:
+        ids = jnp.zeros((B, W), dtype=jnp.int32)
+        for i in range(n):
+            ids = ids * 256 + batch[:, i : i + W].astype(jnp.int32)
+        return ids + spec.offsets[n]
+    h = jnp.full((B, W), _FNV_OFFSET, dtype=jnp.uint32)
+    for i in range(n):
+        h = (h ^ batch[:, i : i + W].astype(jnp.uint32)) * _FNV_PRIME
+    return (h & ((1 << spec.hash_bits) - 1)).astype(jnp.int32)
+
+
+def prefix_hashes(batch: jnp.ndarray, max_len: int, hash_bits: int) -> jnp.ndarray:
+    """FNV-1a bucket of batch[:, :k] for k = 1..max_len → int32 [B, max_len+1].
+
+    Column k holds the bucket of the k-byte prefix (column 0 is zeros/unused).
+    Only needed for hashed-mode partial windows, where max_len < max gram
+    length, so this is a handful of vector ops.
+    """
+    B, S = batch.shape
+    if S < max_len:
+        batch = jnp.pad(batch, ((0, 0), (0, max_len - S)))
+    h = jnp.full((B,), _FNV_OFFSET, dtype=jnp.uint32)
+    cols = [jnp.zeros((B,), dtype=jnp.int32)]
+    mask = jnp.uint32((1 << hash_bits) - 1)
+    for i in range(max_len):
+        h = (h ^ batch[:, i].astype(jnp.uint32)) * _FNV_PRIME
+        cols.append((h & mask).astype(jnp.int32))
+    return jnp.stack(cols, axis=1)
+
+
+def partial_window_ids(
+    batch: jnp.ndarray,
+    lengths: jnp.ndarray,
+    n: int,
+    window0_ids: jnp.ndarray,
+    spec: "VocabSpec",
+) -> jnp.ndarray:
+    """Gram id of the single partial window of each doc with len < n: int32 [B].
+
+    Shared by the scorer and the device fit so the Scala-``sliding`` parity
+    rule lives in exactly one place. Values are only meaningful where
+    ``lengths < n`` and ``lengths > 0``; callers mask everything else.
+
+    Exact mode: window 0's padded polynomial is ``poly(prefix) * 256**(n-len)``
+    (padding bytes are zero), so the prefix id is recovered with a shift into
+    the length-``len`` id space. Hashed mode: FNV prefix buckets.
+    """
+    if spec.mode == EXACT:
+        offsets = spec.offsets
+        pow256 = jnp.array([256**k for k in range(n + 1)], dtype=jnp.int32)
+        off_by_len = jnp.array(
+            [0] + [offsets[k] for k in range(1, n + 1)], dtype=jnp.int32
+        )
+        len_c = jnp.clip(lengths, 0, n)
+        return off_by_len[len_c] + (window0_ids - offsets[n]) // pow256[n - len_c]
+    prefixes = prefix_hashes(batch, n - 1, spec.hash_bits)
+    len_c = jnp.clip(lengths, 0, n - 1)
+    return prefixes[jnp.arange(batch.shape[0]), len_c]
+
+
+def short_doc_ids_numpy(
+    doc: bytes, spec: VocabSpec
+) -> list[int]:
+    """Reference partial-window rule (host): a document shorter than a gram
+    length contributes ONE window of the whole document for that length
+    (Scala ``sliding`` emits a partial final group — SURVEY.md §3.2 hot loop).
+    That partial gram matches learned grams of its own (shorter) length, so it
+    maps into the id space of ``len(doc)``. Returns one id per configured gram
+    length > len(doc) — NOT deduplicated, because the reference looks the
+    partial window up once per gram length, accumulating its weights once each.
+    """
+    n_doc = len(doc)
+    if n_doc == 0 or n_doc >= max(spec.gram_lengths):
+        return []
+    short_id = spec.gram_to_id(bytes(doc))
+    return [short_id for n in spec.gram_lengths if n > n_doc]

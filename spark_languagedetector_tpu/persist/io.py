@@ -1,0 +1,206 @@
+"""Model persistence: parquet layout compatible with the reference's format.
+
+The reference writes (``/root/reference/src/main/.../LanguageDetectorModel.scala:27-105``):
+
+    <path>/metadata/            Spark DefaultParamsWriter JSON
+    <path>/probabilities/       parquet of (gram bytes, weight vector)
+    <path>/supportedLanguages/  parquet of language strings
+    <path>/gramLengths/         parquet of ints
+
+This writer produces the same directory layout with pyarrow parquet files
+(readable by Spark), plus a ``metadata/part-00000`` JSON line carrying the
+class name, uid, params, and the TPU-native extras the reference doesn't have
+(vocab mode, hash bits, weight mode). Hashed profiles have no gram bytes, so
+``probabilities/`` stores bucket ids; the metadata records which flavor was
+written and the reader reconstructs accordingly.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import time
+from pathlib import Path
+
+import numpy as np
+
+from ..models.profile import GramProfile
+from ..ops.vocab import EXACT, HASHED, VocabSpec
+from ..utils.logging import get_logger, log_event
+
+_log = get_logger("persist.io")
+
+_CLASS_NAME = "spark_languagedetector_tpu.models.estimator.LanguageDetectorModel"
+
+
+def _write_parquet(path: Path, table) -> None:
+    import pyarrow.parquet as pq
+
+    path.mkdir(parents=True, exist_ok=True)
+    pq.write_table(table, path / "part-00000.parquet")
+
+
+def _read_parquet(path: Path):
+    import pyarrow.parquet as pq
+
+    files = sorted(path.glob("*.parquet"))
+    if not files:
+        raise FileNotFoundError(f"no parquet files under {path}")
+    import pyarrow as pa
+
+    return pa.concat_tables([pq.read_table(f) for f in files])
+
+
+def save_model(
+    path: str | Path,
+    profile: GramProfile,
+    uid: str,
+    params: dict,
+    overwrite: bool = True,
+) -> None:
+    """Write the model directory (SaveMode.Overwrite semantics)."""
+    import pyarrow as pa
+
+    root = Path(path)
+    if root.exists():
+        if not overwrite:
+            raise FileExistsError(f"{root} already exists")
+        shutil.rmtree(root)
+    root.mkdir(parents=True)
+
+    # metadata/ — single JSON line, Spark DefaultParamsWriter-style fields.
+    meta = {
+        "class": _CLASS_NAME,
+        "timestamp": int(time.time() * 1000),
+        "uid": uid,
+        "paramMap": params,
+        "vocab": {
+            "mode": profile.spec.mode,
+            "gramLengths": list(profile.spec.gram_lengths),
+            "hashBits": profile.spec.hash_bits,
+        },
+        "languages": list(profile.languages),
+    }
+    meta_dir = root / "metadata"
+    meta_dir.mkdir()
+    (meta_dir / "part-00000").write_text(json.dumps(meta) + "\n")
+
+    # probabilities/ — gram bytes (exact) or bucket ids (hashed) + weights.
+    if profile.spec.mode == EXACT:
+        grams = [profile.spec.id_to_gram(int(i)) for i in profile.ids]
+        prob_table = pa.table(
+            {
+                "gram": pa.array(grams, type=pa.binary()),
+                "probabilities": pa.array(
+                    [row.tolist() for row in profile.weights],
+                    type=pa.list_(pa.float64()),
+                ),
+            }
+        )
+    else:
+        nonzero = np.flatnonzero(np.abs(profile.weights).sum(axis=1))
+        prob_table = pa.table(
+            {
+                "bucket": pa.array(nonzero.tolist(), type=pa.int64()),
+                "probabilities": pa.array(
+                    [profile.weights[i].tolist() for i in nonzero],
+                    type=pa.list_(pa.float64()),
+                ),
+            }
+        )
+    _write_parquet(root / "probabilities", prob_table)
+
+    # supportedLanguages/ and gramLengths/ — mirroring the reference layout.
+    _write_parquet(
+        root / "supportedLanguages",
+        pa.table({"value": pa.array(list(profile.languages), type=pa.string())}),
+    )
+    _write_parquet(
+        root / "gramLengths",
+        pa.table({"value": pa.array(list(profile.spec.gram_lengths), type=pa.int32())}),
+    )
+    log_event(_log, "model.saved", path=str(root), grams=profile.num_grams)
+
+
+def load_model(path: str | Path) -> tuple[GramProfile, str, dict]:
+    """Read a model directory → (profile, uid, params).
+
+    Checks the stored class name like the reference reader
+    (LanguageDetectorModel.scala:66,72).
+    """
+    root = Path(path)
+    meta_file = root / "metadata" / "part-00000"
+    meta = json.loads(meta_file.read_text().splitlines()[0])
+    if meta.get("class") != _CLASS_NAME:
+        raise ValueError(
+            f"metadata class mismatch: expected {_CLASS_NAME}, got {meta.get('class')}"
+        )
+
+    languages = tuple(
+        _read_parquet(root / "supportedLanguages")["value"].to_pylist()
+    )
+    gram_lengths = tuple(
+        int(v) for v in _read_parquet(root / "gramLengths")["value"].to_pylist()
+    )
+    vocab_meta = meta.get("vocab", {})
+    mode = vocab_meta.get("mode", EXACT)
+    spec = VocabSpec(mode, gram_lengths, hash_bits=vocab_meta.get("hashBits", 20))
+
+    prob = _read_parquet(root / "probabilities")
+    weights_rows = prob["probabilities"].to_pylist()
+    L = len(languages)
+    if mode == EXACT:
+        grams = prob["gram"].to_pylist()
+        pairs = sorted(
+            (spec.gram_to_id(bytes(g)), np.asarray(w, dtype=np.float64))
+            for g, w in zip(grams, weights_rows)
+        )
+        ids = np.asarray([p[0] for p in pairs], dtype=np.int64)
+        weights = (
+            np.stack([p[1] for p in pairs])
+            if pairs
+            else np.zeros((0, L), dtype=np.float64)
+        )
+    else:
+        ids = np.zeros(0, dtype=np.int64)
+        weights = np.zeros((spec.id_space_size, L), dtype=np.float64)
+        for bucket, row in zip(prob["bucket"].to_pylist(), weights_rows):
+            weights[bucket] = row
+
+    profile = GramProfile(spec=spec, languages=languages, ids=ids, weights=weights)
+    return profile, meta["uid"], meta.get("paramMap", {})
+
+
+def save_gram_dump(path: str | Path, profile: GramProfile) -> None:
+    """The reference's ``saveGramsToHDFS`` artifact
+    (LanguageDetector.scala:167-171): the fitted gram-probability dataset as
+    parquet, overwrite mode."""
+    import pyarrow as pa
+
+    root = Path(path)
+    if root.exists():
+        shutil.rmtree(root)
+    if profile.spec.mode == EXACT:
+        grams = [profile.spec.id_to_gram(int(i)) for i in profile.ids]
+        table = pa.table(
+            {
+                "gram": pa.array(grams, type=pa.binary()),
+                "probabilities": pa.array(
+                    [row.tolist() for row in profile.weights],
+                    type=pa.list_(pa.float64()),
+                ),
+            }
+        )
+    else:
+        nonzero = np.flatnonzero(np.abs(profile.weights).sum(axis=1))
+        table = pa.table(
+            {
+                "bucket": pa.array(nonzero.tolist(), type=pa.int64()),
+                "probabilities": pa.array(
+                    [profile.weights[i].tolist() for i in nonzero],
+                    type=pa.list_(pa.float64()),
+                ),
+            }
+        )
+    _write_parquet(root, table)
+    log_event(_log, "grams.saved", path=str(root))

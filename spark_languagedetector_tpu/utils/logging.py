@@ -1,0 +1,45 @@
+"""Structured logging for the framework.
+
+The reference mixes in Spark's ``Logging`` trait (e.g.
+``/root/reference/src/main/.../LanguageDetector.scala:17``) but emits almost
+nothing; its tests force log4j to ERROR. Here logging is a first-class,
+structured subsystem (SURVEY.md §5.5): a per-module logger with a shared
+framework namespace, quiet by default, and a ``log_event`` helper that attaches
+machine-readable key/value fields for throughput meters and test assertions.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any
+
+_ROOT_NAME = "sparklangdetect_tpu"
+
+_root = logging.getLogger(_ROOT_NAME)
+if not _root.handlers:
+    _handler = logging.StreamHandler()
+    _handler.setFormatter(
+        logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+    )
+    _root.addHandler(_handler)
+    _root.setLevel(os.environ.get("LANGDETECT_TPU_LOGLEVEL", "WARNING").upper())
+    _root.propagate = False
+
+
+def get_logger(module: str) -> logging.Logger:
+    """Logger namespaced under the framework root, e.g. ``ops.score``."""
+    return logging.getLogger(f"{_ROOT_NAME}.{module}")
+
+
+def set_level(level: str) -> None:
+    _root.setLevel(level.upper())
+
+
+def log_event(logger: logging.Logger, event: str, **fields: Any) -> None:
+    """Emit a structured (JSON-payload) INFO event; cheap when disabled."""
+    if logger.isEnabledFor(logging.INFO):
+        payload = {"event": event, "ts": time.time(), **fields}
+        logger.info(json.dumps(payload, default=str))

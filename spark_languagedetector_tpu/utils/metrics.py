@@ -1,0 +1,47 @@
+"""Lightweight counters/timers for observability (SURVEY.md §5.5).
+
+The reference has no metrics at all; the BASELINE target (docs/sec/chip) makes
+a throughput meter mandatory. These counters are process-local and lock-free
+(CPython atomic int ops) — device-side timing uses ``block_until_ready``
+explicitly at the call sites that care.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Metrics:
+    counters: dict[str, int] = field(default_factory=lambda: defaultdict(int))
+    timers: dict[str, float] = field(default_factory=lambda: defaultdict(float))
+
+    def incr(self, name: str, value: int = 1) -> None:
+        self.counters[name] += value
+
+    @contextmanager
+    def timer(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.timers[name] += time.perf_counter() - t0
+
+    def throughput(self, counter: str, timer: str) -> float:
+        """counter/sec over accumulated timer time; 0.0 if never timed."""
+        elapsed = self.timers.get(timer, 0.0)
+        return self.counters.get(counter, 0) / elapsed if elapsed > 0 else 0.0
+
+    def snapshot(self) -> dict:
+        return {"counters": dict(self.counters), "timers": dict(self.timers)}
+
+    def reset(self) -> None:
+        self.counters.clear()
+        self.timers.clear()
+
+
+# Framework-global registry (scorers attach their own Metrics too).
+GLOBAL = Metrics()

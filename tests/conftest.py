@@ -1,0 +1,37 @@
+"""Test fixture: single-process fake of the distributed substrate.
+
+The reference tests against Spark ``local[4]`` — real shuffles/broadcasts in
+one JVM (``/root/reference/src/test/.../Spark.scala:6-22``). The TPU-native
+analog (SURVEY.md §4): the JAX CPU backend with 8 virtual host devices, so
+mesh/sharding/collective code runs real XLA collectives without TPU hardware.
+Must be set before jax initializes, hence module-level in conftest.
+"""
+
+import os
+
+# Force CPU even when the host environment pins JAX to a TPU backend: unit
+# tests must be deterministic and see 8 virtual devices. The axon TPU-tunnel
+# sitecustomize sets the *programmatic* jax_platforms config (which overrides
+# the env var) to "axon,cpu" at interpreter start, so setting the env var is
+# not enough — update the config before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) >= 8, f"expected 8 virtual devices, got {devices}"
+    return devices

@@ -1,0 +1,118 @@
+"""Pure-Python oracle: a direct behavioral model of the reference algorithm.
+
+Reimplements the reference's fit/detect semantics with plain dicts and floats
+(no JAX, no numpy vectorization) to serve as the accuracy-parity oracle the
+framework is tested against — the analog of the reference's hand-built tiny
+profiles (``LanguageDetectorModelSpecs.scala:26-35``) but covering fit too.
+
+Behavioral citations:
+  * sliding windows incl. partial final group — LanguageDetector.scala:36-43,
+    LanguageDetectorModel.scala:139-152 (Scala ``sliding`` semantics)
+  * weight = log(1 + presence / #langs containing) — LanguageDetector.scala:86-87
+  * per-language top-k then union — LanguageDetector.scala:100-132
+  * scorer: sum weight vectors of matched windows, argmax (first max wins),
+    zero-hit ⇒ index 0 — LanguageDetectorModel.scala:131-156
+  * String→bytes predict path truncates UTF-16 units to low byte
+    — LanguageDetectorModel.scala:158-165
+"""
+
+from __future__ import annotations
+
+import math
+from collections import Counter, defaultdict
+
+
+def sliding(seq: bytes, n: int) -> list[bytes]:
+    """Scala ``sliding(n)``: all full windows; one partial group if len < n;
+    nothing for an empty sequence."""
+    if len(seq) == 0:
+        return []
+    if len(seq) < n:
+        return [seq]
+    return [seq[i : i + n] for i in range(len(seq) - n + 1)]
+
+
+def fit_oracle(
+    docs: list[tuple[str, str]],
+    supported_languages: list[str],
+    gram_lengths: list[int],
+    profile_size: int,
+    weight_mode: str = "parity",
+) -> dict[bytes, list[float]]:
+    """(lang, text) pairs → gram → weight-vector map."""
+    counts: dict[bytes, Counter] = defaultdict(Counter)
+    for lang, text in docs:
+        data = text.encode("utf-8")
+        for n in gram_lengths:
+            for gram in sliding(data, n):
+                counts[gram][lang] += 1
+
+    weights: dict[bytes, list[float]] = {}
+    for gram, per_lang in counts.items():
+        if weight_mode == "parity":
+            nlangs = len(per_lang)
+            weights[gram] = [
+                math.log1p((1.0 if l in per_lang else 0.0) / nlangs)
+                for l in supported_languages
+            ]
+        else:
+            total = sum(per_lang.values())
+            weights[gram] = [
+                math.log1p(per_lang.get(l, 0) / total) for l in supported_languages
+            ]
+
+    winners: set[bytes] = set()
+    for i, _ in enumerate(supported_languages):
+        # Tie-break mirrors the framework's gram-id ascending order: ids are
+        # grouped by gram length first, lexicographic by bytes within a length.
+        ranked = sorted(
+            weights.items(), key=lambda kv: (-kv[1][i], len(kv[0]), kv[0])
+        )
+        winners.update(g for g, _ in ranked[:profile_size])
+    return {g: weights[g] for g in winners}
+
+
+def detect_oracle(
+    text: str,
+    gram_map: dict[bytes, list[float]],
+    supported_languages: list[str],
+    gram_lengths: list[int],
+    encoding: str = "utf8",
+) -> str:
+    data = (
+        text.encode("utf-8")
+        if encoding == "utf8"
+        else bytes(b for b in text.encode("utf-16-le")[::2])
+    )
+    L = len(supported_languages)
+    acc = [0.0] * L
+    for n in gram_lengths:
+        for gram in sliding(data, n):
+            vec = gram_map.get(gram)
+            if vec is not None:
+                for i in range(L):
+                    acc[i] += vec[i]
+    best = max(range(L), key=lambda i: (acc[i], -i))  # first max wins
+    return supported_languages[best]
+
+
+def scores_oracle(
+    text: str,
+    gram_map: dict[bytes, list[float]],
+    num_languages: int,
+    gram_lengths: list[int],
+    encoding: str = "utf8",
+) -> list[float]:
+    data = (
+        text.encode("utf-8")
+        if encoding == "utf8"
+        else bytes(b for b in text.encode("utf-16-le")[::2])
+    )
+    acc = [0.0] * num_languages
+    for n in gram_lengths:
+        for gram in sliding(data, n):
+            vec = gram_map.get(gram)
+            if vec is not None:
+                for i in range(num_languages):
+                    acc[i] += vec[i]
+    return acc

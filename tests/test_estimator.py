@@ -1,0 +1,183 @@
+"""Estimator/Model API tests — mirrors the reference's spec suite (SURVEY.md §4)
+plus what the reference never tested (preprocessors, encodings, params)."""
+
+import numpy as np
+import pytest
+
+from spark_languagedetector_tpu import LanguageDetector, LanguageDetectorModel, Table
+from spark_languagedetector_tpu.ops.vocab import HASHED
+
+from .oracle import detect_oracle, fit_oracle
+
+TRAIN_ROWS = {
+    "lang": ["de", "de", "en", "en"],
+    "fulltext": [
+        "Dies ist ein deutscher Text, das ist ja sehr schön",
+        "Dies ist ein andere deutscher Text, und der ist auch sehr schön",
+        "This is a text in english, and that is very nice",
+        "This is another text in english and that is also nice",
+    ],
+}
+
+
+def test_fit_basic_model_reference_spec():
+    """LanguageDetectorSpecs.scala:15-40: trigram, k=5, 2 langs ⇒ 10 grams,
+    length-2 weight vectors."""
+    detector = LanguageDetector(["de", "en"], [3], 5)
+    model = detector.fit(Table(TRAIN_ROWS))
+    assert len(model.gram_probabilities) == 10
+    assert len(next(iter(model.gram_probabilities.values()))) == 2
+
+
+def test_fit_rejects_unsupported_language():
+    """LanguageDetector.scala:221-228 (message preserved verbatim)."""
+    data = Table(
+        {
+            "lang": ["de", "es"],
+            "fulltext": ["Dies ist deutsch", "Habla espanol"],
+        }
+    )
+    detector = LanguageDetector(["de", "en"], [3], 5)
+    with pytest.raises(ValueError, match="contians es, but it is not"):
+        detector.fit(data)
+
+
+def test_fit_rejects_language_without_examples():
+    """LanguageDetectorSpecs.scala:43-66: exact reference error message."""
+    data = Table(
+        {
+            "lang": ["de", "de"],
+            "fulltext": ["Dies ist deutsch", "Noch ein deutscher Text"],
+        }
+    )
+    detector = LanguageDetector(["de", "en"], [3], 5)
+    with pytest.raises(
+        ValueError,
+        match="No training examples found for language en. "
+        "Provide examples for each language",
+    ):
+        detector.fit(data)
+
+
+def test_transform_with_handbuilt_model_reference_spec():
+    """LanguageDetectorModelSpecs.scala:13-47: hand-built model, 4 docs ⇒
+    2 de + 2 en, row count preserved, output appended as 'lang'."""
+    model = LanguageDetectorModel.from_gram_map(
+        {b"Die": [1.0, 0.0], b"Thi": [0.0, 1.0]}, [3], ["de", "en"]
+    )
+    data = Table({"fulltext": TRAIN_ROWS["fulltext"]})
+    out = model.transform(data)
+    assert out.num_rows == 4
+    langs = out.column("lang").tolist()
+    assert langs.count("de") == 2
+    assert langs.count("en") == 2
+    assert out.schema.names == ["fulltext", "lang"]
+
+
+def test_transform_requires_string_input_column():
+    """LanguageDetectorModel.scala:206-209."""
+    model = LanguageDetectorModel.from_gram_map({b"a": [1.0]}, [1], ["aa"])
+    with pytest.raises(TypeError, match="Input type must be string"):
+        model.transform(Table({"fulltext": np.asarray([1, 2, 3])}))
+    with pytest.raises(KeyError):
+        model.transform(Table({"other": ["text"]}))
+
+
+def test_fit_then_transform_end_to_end_matches_oracle():
+    detector = LanguageDetector(["de", "en"], [2, 3], 20)
+    model = detector.fit(Table(TRAIN_ROWS))
+    test_texts = [
+        "Das ist wunderbar und sehr schön",
+        "The weather is very nice today",
+    ]
+    out = model.transform(Table({"fulltext": test_texts}))
+
+    train_pairs = list(zip(TRAIN_ROWS["lang"], TRAIN_ROWS["fulltext"]))
+    gram_map = fit_oracle(train_pairs, ["de", "en"], [2, 3], 20)
+    expected = [
+        detect_oracle(t, gram_map, ["de", "en"], [2, 3]) for t in test_texts
+    ]
+    assert out.column("lang").tolist() == expected == ["de", "en"]
+
+
+def test_custom_column_names():
+    detector = (
+        LanguageDetector(["de", "en"], [3], 5)
+        .set_input_col("body")
+        .set_label_col("language")
+    )
+    model = detector.fit(
+        Table({"language": TRAIN_ROWS["lang"], "body": TRAIN_ROWS["fulltext"]})
+    )
+    model.set_input_col("body").set_output_col("detected")
+    out = model.transform(Table({"body": ["Dies ist ein deutscher Text schön"]}))
+    assert out.schema.names == ["body", "detected"]
+
+
+def test_low_byte_predict_encoding_parity_quirk():
+    """Q2: with predictEncoding='low_byte', non-ASCII grams learned at fit
+    (UTF-8) can never match at predict — reference behavior."""
+    model = LanguageDetectorModel.from_gram_map(
+        {"schön".encode("utf-8")[-3:]: [1.0, 0.0], b"nic": [0.0, 1.0]},
+        [3],
+        ["de", "en"],
+    )
+    text = "schön"
+    assert model.detect(text) == "de"  # utf8 default: gram matches
+    model.set_predict_encoding("low_byte")
+    assert model.detect(text) == "de"  # all-miss → first language (Q6)
+
+
+def test_cpu_backend_param_places_scoring_on_cpu():
+    model = LanguageDetectorModel.from_gram_map(
+        {b"ab": [1.0, 0.0]}, [2], ["x", "y"]
+    ).set_backend("cpu")
+    assert model.detect("abab") == "x"
+    runner = model._get_runner()
+    assert runner.device is not None and runner.device.platform == "cpu"
+
+
+def test_param_change_invalidates_cached_runner():
+    model = LanguageDetectorModel.from_gram_map({b"ab": [1.0]}, [2], ["x"])
+    model.detect("ab")
+    first = model._runner
+    assert first is not None
+    model.set_batch_size(16)
+    assert model._runner is None
+    model.detect("ab")
+    assert model._runner.batch_size == 16
+    clone = model.copy()
+    assert clone._runner is None
+
+
+def test_hashed_vocab_fit_and_transform():
+    detector = (
+        LanguageDetector(["de", "en"], [1, 2, 3, 4, 5], 50)
+        .set_vocab_mode(HASHED)
+        .set_hash_bits(16)
+    )
+    model = detector.fit(Table(TRAIN_ROWS))
+    out = model.transform(
+        Table({"fulltext": ["Das ist schön und wunderbar", "this is very nice"]})
+    )
+    assert out.column("lang").tolist() == ["de", "en"]
+
+
+def test_copy_covers_all_params():
+    detector = LanguageDetector(["de", "en"], [3], 5)
+    clone = detector.copy({"languageProfileSize": 9})
+    assert clone.get("languageProfileSize") == 9
+    assert clone.get("supportedLanguages") == ["de", "en"]
+    assert detector.get("languageProfileSize") == 5
+    assert clone.uid == detector.uid
+
+
+def test_save_grams_to(tmp_path):
+    import pyarrow.parquet as pq
+
+    path = str(tmp_path / "grams")
+    detector = LanguageDetector(["de", "en"], [3], 5).set_save_grams_to(path)
+    detector.fit(Table(TRAIN_ROWS))
+    table = pq.read_table(path + "/part-00000.parquet")
+    assert table.num_rows == 10
+    assert set(table.column_names) == {"gram", "probabilities"}

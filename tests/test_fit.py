@@ -1,0 +1,98 @@
+"""Host fit parity: numpy fit vs the pure-Python reference oracle."""
+
+import numpy as np
+
+from spark_languagedetector_tpu.ops import fit as F
+from spark_languagedetector_tpu.ops.encoding import texts_to_bytes
+from spark_languagedetector_tpu.ops.vocab import EXACT, HASHED, VocabSpec
+
+from .oracle import fit_oracle
+
+LANGS = ["de", "en"]
+TRAIN = [
+    ("de", "Dies ist ein deutscher Text, das ist ja sehr schön"),
+    ("de", "Dies ist ein andere deutscher Text, und der ist auch sehr schön"),
+    ("en", "This is a text in english, and that is very nice"),
+    ("en", "This is another text in english and that is also nice"),
+]
+
+
+def _fit(train, langs, gram_lengths, k, weight_mode="parity", spec=None):
+    spec = spec or VocabSpec(EXACT, tuple(gram_lengths))
+    docs = texts_to_bytes([t for _, t in train])
+    lang_idx = np.asarray([langs.index(l) for l, _ in train])
+    ids, weights = F.fit_profile_numpy(
+        docs, lang_idx, len(langs), spec, k, weight_mode
+    )
+    return spec, ids, weights
+
+
+def test_fit_profile_cardinality_matches_reference_spec():
+    """Reference fit unit test (LanguageDetectorSpecs.scala:15-40): trigram,
+    k=5, 2 languages ⇒ 10 grams, length-2 weight vectors (no shared winners
+    in this corpus)."""
+    spec, ids, weights = _fit(TRAIN, LANGS, [3], 5)
+    assert len(ids) == 10
+    assert weights.shape == (10, 2)
+
+
+def test_fit_matches_oracle_gram_set_and_weights():
+    for gram_lengths, k in [([3], 5), ([1, 2], 7), ([2, 3], 4)]:
+        spec, ids, weights = _fit(TRAIN, LANGS, gram_lengths, k)
+        expected = fit_oracle(TRAIN, LANGS, gram_lengths, k)
+        got = {spec.id_to_gram(int(i)): weights[r] for r, i in enumerate(ids)}
+        assert set(got) == set(expected), (
+            sorted(set(got) - set(expected)),
+            sorted(set(expected) - set(got)),
+        )
+        for gram, vec in expected.items():
+            np.testing.assert_allclose(got[gram], vec, rtol=1e-12)
+
+
+def test_fit_counts_mode_matches_oracle():
+    spec, ids, weights = _fit(TRAIN, LANGS, [2], 6, weight_mode="counts")
+    expected = fit_oracle(TRAIN, LANGS, [2], 6, weight_mode="counts")
+    got = {spec.id_to_gram(int(i)): weights[r] for r, i in enumerate(ids)}
+    assert set(got) == set(expected)
+    for gram, vec in expected.items():
+        np.testing.assert_allclose(got[gram], vec, rtol=1e-12)
+
+
+def test_fit_learns_partial_grams_from_short_docs():
+    """A training doc shorter than the gram length contributes one partial
+    gram (Scala sliding parity in fit, LanguageDetector.scala:39)."""
+    train = [("de", "ab"), ("en", "xyz")]
+    spec, ids, weights = _fit(train, LANGS, [3], 5)
+    grams = {spec.id_to_gram(int(i)) for i in ids}
+    assert b"ab" in grams
+    assert b"xyz" in grams
+
+
+def test_fit_shared_grams_get_split_weights():
+    """A gram present in both languages: parity weight log1p(1/2) for both."""
+    train = [("de", "aaa"), ("en", "aaa"), ("de", "bbb"), ("en", "ccc")]
+    spec, ids, weights = _fit(train, LANGS, [3], 5)
+    got = {spec.id_to_gram(int(i)): weights[r] for r, i in enumerate(ids)}
+    np.testing.assert_allclose(got[b"aaa"], [np.log1p(0.5)] * 2)
+    np.testing.assert_allclose(got[b"bbb"], [np.log1p(1.0), 0.0])
+    np.testing.assert_allclose(got[b"ccc"], [0.0, np.log1p(1.0)])
+
+
+def test_fit_hashed_mode_runs():
+    spec = VocabSpec(HASHED, (1, 2, 3, 4, 5), hash_bits=14)
+    docs = texts_to_bytes([t for _, t in TRAIN])
+    lang_idx = np.asarray([LANGS.index(l) for l, _ in TRAIN])
+    counts = F.extract_gram_counts(docs, lang_idx, 2, spec)
+    assert counts.ids.max() < spec.id_space_size
+    unique_ids, weights = F.compute_weights(counts)
+    ids, w = F.select_top_grams(unique_ids, weights, 10)
+    assert len(ids) <= 20 and w.shape[1] == 2
+
+
+def test_gram_counts_total_equals_window_count():
+    """Total counted occurrences == Σ per-doc window counts (incl. partials)."""
+    spec = VocabSpec(EXACT, (2,))
+    docs = texts_to_bytes(["abcd", "a", ""])
+    counts = F.extract_gram_counts(docs, np.asarray([0, 0, 1]), 2, spec)
+    # "abcd" → 3 windows, "a" → 1 partial, "" → 0.
+    assert counts.counts.sum() == 4

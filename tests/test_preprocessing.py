@@ -1,0 +1,57 @@
+"""Preprocessor tests — coverage the reference never had (SURVEY.md §4:
+'Not tested at all: both preprocessors')."""
+
+from spark_languagedetector_tpu import (
+    LowerCasePreprocessor,
+    SpecialCharPreprocessor,
+    Table,
+)
+
+
+def test_lowercase_basic():
+    t = Table({"lang": ["en"], "fulltext": ["Hello WORLD"]})
+    out = LowerCasePreprocessor().transform(t)
+    assert out.column("fulltext").tolist() == ["hello world"]
+
+
+def test_lowercase_uses_label_locale_turkish():
+    """Java Locale('tr') semantics: dotted/dotless i."""
+    t = Table({"lang": ["tr", "en"], "fulltext": ["IŞIK İstanbul", "III"]})
+    out = LowerCasePreprocessor().transform(t)
+    assert out.column("fulltext").tolist() == ["ışık istanbul", "iii"]
+
+
+def test_lowercase_set_input_col_sets_output_col_quirk():
+    """Q8: setInputCol actually sets outputCol (LowerCasePreprocessor.scala:32)."""
+    p = LowerCasePreprocessor().set_input_col("body")
+    assert p.get_output_col() == "body"
+    t = Table({"lang": ["en"], "body": ["ABC"]})
+    assert p.transform(t).column("body").tolist() == ["abc"]
+
+
+def test_lowercase_schema_moves_column_last():
+    """In-place column replace re-appends the column last
+    (LowerCasePreprocessor.scala:38-42)."""
+    t = Table({"fulltext": ["A"], "lang": ["en"], "id": [1]})
+    out = LowerCasePreprocessor().transform(t)
+    assert out.schema.names == ["lang", "id", "fulltext"]
+
+
+def test_specialchar_strips_intended_symbols():
+    """Q3 fixed: the symbol set the reference's invalid regex intended."""
+    t = Table({"fulltext": ['a/b_c[d]e*f(g)h%i^j&k@l$m#n:o|p{q}r<s>t~u`v"w\\x']})
+    out = SpecialCharPreprocessor().transform(t)
+    assert out.column("fulltext").tolist() == ["abcdefghijklmnopqrstuvwx"]
+
+
+def test_specialchar_squashes_whitespace():
+    """Q4 fixed: whitespace runs squash to one space (not deleted)."""
+    t = Table({"fulltext": ["hello   world  again"]})
+    out = SpecialCharPreprocessor().transform(t)
+    assert out.column("fulltext").tolist() == ["hello world again"]
+
+
+def test_preprocessing_pipeline_chains():
+    t = Table({"lang": ["de"], "fulltext": ["Das  ist  (sehr)  SCHÖN!"]})
+    out = LowerCasePreprocessor().transform(SpecialCharPreprocessor().transform(t))
+    assert out.column("fulltext").tolist() == ["das ist sehr schön!"]

@@ -1,0 +1,82 @@
+"""Vocab spec: id encoding round-trips and host/device lockstep."""
+
+import numpy as np
+import pytest
+
+from spark_languagedetector_tpu.ops import vocab as V
+
+
+def test_exact_offsets_cover_all_lengths_below_max():
+    spec = V.VocabSpec(V.EXACT, (3,))
+    assert set(spec.offsets) == {1, 2, 3}
+    assert spec.offsets[1] == 0
+    assert spec.offsets[2] == 256
+    assert spec.offsets[3] == 256 + 65536
+    assert spec.id_space_size == 256 + 65536 + 256**3
+
+
+def test_exact_gram_id_roundtrip():
+    spec = V.VocabSpec(V.EXACT, (1, 2, 3))
+    for gram in [b"a", b"ab", b"abc", b"\x00\x00", b"\xff\xff\xff", b"\x00"]:
+        gid = spec.gram_to_id(gram)
+        assert spec.id_to_gram(gid) == gram
+
+
+def test_exact_ids_are_disjoint_across_lengths():
+    spec = V.VocabSpec(V.EXACT, (2, 3))
+    ids = set()
+    for gram in [b"a", b"b", b"aa", b"ab", b"aaa", b"\x00\x00\x00"]:
+        gid = spec.gram_to_id(gram)
+        assert gid not in ids
+        ids.add(gid)
+
+
+def test_exact_mode_rejects_long_grams():
+    with pytest.raises(ValueError, match="hashed"):
+        V.VocabSpec(V.EXACT, (1, 5))
+
+
+def test_hashed_mode_buckets_in_range():
+    spec = V.VocabSpec(V.HASHED, (1, 2, 5), hash_bits=12)
+    for gram in [b"a", b"hello", b"\xff" * 5]:
+        assert 0 <= spec.gram_to_id(gram) < 4096
+
+
+def test_window_ids_numpy_matches_scalar():
+    spec = V.VocabSpec(V.EXACT, (2,))
+    doc = b"abcd"
+    batch = np.frombuffer(doc, dtype=np.uint8)[None, :]
+    ids = V.window_ids_numpy(batch, 2, spec)[0]
+    expected = [spec.gram_to_id(doc[i : i + 2]) for i in range(3)]
+    assert ids.tolist() == expected
+
+
+def test_window_ids_device_matches_numpy_exact_and_hashed():
+    rng = np.random.default_rng(0)
+    batch = rng.integers(0, 256, size=(4, 32), dtype=np.uint8)
+    for spec in [
+        V.VocabSpec(V.EXACT, (1, 2, 3)),
+        V.VocabSpec(V.HASHED, (2, 4), hash_bits=20),
+    ]:
+        for n in spec.gram_lengths:
+            host = V.window_ids_numpy(batch, n, spec)
+            dev = np.asarray(V.window_ids(batch, n, spec))
+            np.testing.assert_array_equal(host, dev.astype(np.int64))
+
+
+def test_hashed_window_ids_match_scalar_hash():
+    spec = V.VocabSpec(V.HASHED, (3,), hash_bits=16)
+    doc = b"hello world"
+    batch = np.frombuffer(doc, dtype=np.uint8)[None, :]
+    ids = V.window_ids_numpy(batch, 3, spec)[0]
+    expected = [spec.gram_to_id(doc[i : i + 3]) for i in range(len(doc) - 2)]
+    assert ids.tolist() == expected
+
+
+def test_short_doc_ids_one_per_longer_gram_length():
+    spec = V.VocabSpec(V.EXACT, (2, 3))
+    assert V.short_doc_ids_numpy(b"", spec) == []
+    ids = V.short_doc_ids_numpy(b"a", spec)
+    assert ids == [spec.gram_to_id(b"a")] * 2  # once for n=2, once for n=3
+    ids2 = V.short_doc_ids_numpy(b"ab", spec)
+    assert ids2 == [spec.gram_to_id(b"ab")]  # only n=3 is longer

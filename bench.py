@@ -1,0 +1,184 @@
+"""Benchmark: langid docs/sec/chip vs a per-row CPU scoring baseline.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "docs/sec", "vs_baseline": N}
+
+Config (BASELINE.md config 1 by default): bigram+trigram byte model over a
+synthetic multi-language Wikipedia-like corpus; baseline = the reference's
+per-row scoring semantics (per-window dict lookup + vector accumulate,
+LanguageDetectorModel.scala:139-152) reimplemented in Python, measured on
+this host's CPU; TPU number = the framework's micro-batched device scorer.
+
+The baseline is *measured, not cited* (BASELINE.md). Accuracy parity is a
+hard gate: if device argmax labels disagree with the baseline on the
+comparison subset, the script exits nonzero instead of reporting perf.
+
+Environment knobs:
+    BENCH_CONFIG       1 (default) | 3 | 5  — which BASELINE config shape
+    BENCH_DOCS         number of docs to score (default 20000)
+    BENCH_BASELINE_DOCS  docs for the CPU baseline timing (default 1000)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+# ---------------------------------------------------------------- corpus ----
+_LANG_CHARS = {
+    "en": "the quick brown fox jumps over lazy dog and that is very nice ",
+    "de": "der schnelle braune fuchs springt über den faulen hund schön ",
+    "fr": "le renard brun rapide saute par dessus chien paresseux très ",
+    "es": "el zorro marrón rápido salta sobre perro perezoso muy bien ",
+    "it": "la volpe marrone veloce salta sopra il cane pigro molto bene ",
+    "nl": "de snelle bruine vos springt over de luie hond erg mooi ",
+    "pt": "a raposa marrom rápida pula sobre o cão preguiçoso muito bom ",
+    "sv": "den snabba bruna räven hoppar över den lata hunden mycket fin ",
+    "pl": "szybki brązowy lis przeskakuje nad leniwym psem bardzo ładnie ",
+    "fi": "nopea ruskea kettu hyppää laiskan koiran yli erittäin mukava ",
+}
+
+
+def make_corpus(langs, n_docs, mean_len=1500, seed=0):
+    """Synthetic Wikipedia-like docs: ~mean_len bytes of language-typical words."""
+    rng = np.random.default_rng(seed)
+    docs, labels = [], []
+    word_lists = {l: _LANG_CHARS[l].split() for l in langs}
+    for i in range(n_docs):
+        lang = langs[i % len(langs)]
+        words = word_lists[lang]
+        target = max(30, int(rng.normal(mean_len, mean_len / 4)))
+        n_words = max(4, target // 7)
+        text = " ".join(rng.choice(words, size=n_words))
+        docs.append(text)
+        labels.append(lang)
+    return docs, labels
+
+
+# ------------------------------------------------- reference CPU baseline ----
+def baseline_score(text: str, gram_map: dict, num_langs: int, gram_lengths):
+    """Reference hot-loop semantics: per-window map lookup + accumulate."""
+    data = text.encode("utf-8")
+    acc = [0.0] * num_langs
+    for n in gram_lengths:
+        if len(data) >= n:
+            for i in range(len(data) - n + 1):
+                vec = gram_map.get(data[i : i + n])
+                if vec is not None:
+                    for j in range(num_langs):
+                        acc[j] += vec[j]
+        elif data:
+            vec = gram_map.get(data)
+            if vec is not None:
+                for j in range(num_langs):
+                    acc[j] += vec[j]
+    return acc
+
+
+def main():
+    config = int(os.environ.get("BENCH_CONFIG", "1"))
+    n_docs = int(os.environ.get("BENCH_DOCS", "20000"))
+    n_baseline = int(os.environ.get("BENCH_BASELINE_DOCS", "1000"))
+
+    if config == 1:
+        langs, gram_lengths, k, vocab_mode, bits = (
+            ["en", "de", "fr"], [2], 2000, "exact", 20)
+        label = "config1 bigram en/de/fr"
+    elif config == 3:
+        langs, gram_lengths, k, vocab_mode, bits = (
+            list(_LANG_CHARS), [1, 2, 3], 3000, "exact", 20)
+        label = "config3-ish n=1..3, 10 languages"
+    else:
+        langs, gram_lengths, k, vocab_mode, bits = (
+            list(_LANG_CHARS), [1, 2, 3, 4, 5], 3000, "hashed", 20)
+        label = "config5-ish n=1..5 hashed 2^20"
+
+    from spark_languagedetector_tpu import LanguageDetector, Table
+
+    train_docs, train_labels = make_corpus(langs, 60 * len(langs), seed=1)
+    detector = LanguageDetector(langs, gram_lengths, k).set_vocab_mode(
+        vocab_mode
+    ).set_hash_bits(bits)
+    model = detector.fit(Table({"lang": train_labels, "fulltext": train_docs}))
+
+    eval_docs, _ = make_corpus(langs, n_docs, seed=2)
+    eval_bytes_total = sum(len(d.encode()) for d in eval_docs)
+
+    # --- CPU baseline (reference per-row semantics), measured --------------
+    gram_map = (
+        {g: list(v) for g, v in model.gram_probabilities.items()}
+        if vocab_mode == "exact"
+        else None
+    )
+    sub = eval_docs[:n_baseline]
+    if gram_map is not None:
+        t0 = time.perf_counter()
+        base_scores = [baseline_score(t, gram_map, len(langs), gram_lengths) for t in sub]
+        t_base = time.perf_counter() - t0
+    else:
+        # Hashed mode has no byte-keyed map; baseline uses bucket dict.
+        w = model.profile.weights
+        nz = np.flatnonzero(np.abs(w).sum(axis=1))
+        bucket_map = {int(b): w[b].tolist() for b in nz}
+        spec = model.profile.spec
+        t0 = time.perf_counter()
+        base_scores = []
+        for text in sub:
+            data = text.encode("utf-8")
+            acc = [0.0] * len(langs)
+            for n in gram_lengths:
+                for i in range(max(len(data) - n + 1, 0)):
+                    vec = bucket_map.get(spec.gram_to_id(data[i : i + n]))
+                    if vec is not None:
+                        for j in range(len(langs)):
+                            acc[j] += vec[j]
+            base_scores.append(acc)
+        t_base = time.perf_counter() - t0
+    baseline_dps = len(sub) / t_base
+
+    # --- framework scorer on the accelerator -------------------------------
+    from spark_languagedetector_tpu.ops.encoding import texts_to_bytes
+
+    runner = model._get_runner()
+    docs_b = texts_to_bytes(eval_docs)
+    # Warmup = one full pass, so every (batch, length-bucket) shape XLA will
+    # see — including the ragged final batch — is compiled outside the timed
+    # window.
+    scores = runner.score(docs_b)
+    t0 = time.perf_counter()
+    scores = runner.score(docs_b)
+    t_dev = time.perf_counter() - t0
+    device_dps = n_docs / t_dev
+
+    # --- accuracy parity (hard gate: a broken scorer must not print a
+    # plausible speedup) -----------------------------------------------------
+    base_pred = [int(np.argmax(s)) for s in base_scores]
+    dev_pred = np.argmax(scores[: len(sub)], axis=1).tolist()
+    parity = float(np.mean([a == b for a, b in zip(base_pred, dev_pred)]))
+    if parity < 1.0:
+        raise SystemExit(
+            f"accuracy parity violated: {parity:.4f} — device argmax disagrees "
+            f"with the reference-semantics baseline; refusing to report perf"
+        )
+
+    import jax
+
+    result = {
+        "metric": f"langid docs/sec/chip ({label}, {jax.default_backend()})",
+        "value": round(device_dps, 1),
+        "unit": "docs/sec",
+        "vs_baseline": round(device_dps / baseline_dps, 2),
+        "baseline_docs_per_s": round(baseline_dps, 1),
+        "argmax_parity": parity,
+        "eval_docs": n_docs,
+        "eval_mb": round(eval_bytes_total / 1e6, 1),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

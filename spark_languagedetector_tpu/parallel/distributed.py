@@ -1,0 +1,75 @@
+"""Multi-host initialization and host-level data distribution.
+
+The reference's multi-machine story is Spark's cluster manager + shuffle
+service (SURVEY.md §2.3). The TPU-native story: ``jax.distributed`` brings up
+the slice-wide runtime (one process per host, ICI inside the slice, DCN
+between hosts), after which the mesh in ``mesh.py`` spans every host's
+devices and the SPMD code in ``sharded.py`` runs unchanged — GSPMD routes
+collectives over ICI within the slice and DCN across slices.
+
+Host-side responsibilities that remain explicit (the ``mapPartitions``
+analog): each host feeds only its own shard of documents (``host_shard``),
+and globally-addressed arrays are assembled with
+``jax.make_array_from_process_local_data``.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+from ..utils.logging import get_logger, log_event
+
+_log = get_logger("parallel.distributed")
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Bring up the multi-host runtime (idempotent, no-op single-process).
+
+    On Cloud TPU the three arguments are auto-detected from the metadata
+    server; elsewhere pass them explicitly or via the env vars
+    ``LANGDETECT_TPU_COORDINATOR`` / ``LANGDETECT_TPU_NUM_PROCESSES`` /
+    ``LANGDETECT_TPU_PROCESS_ID``, mirroring ``jax.distributed.initialize``.
+    """
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("LANGDETECT_TPU_COORDINATOR")
+    if num_processes is None:
+        env_procs = os.environ.get("LANGDETECT_TPU_NUM_PROCESSES")
+        num_processes = int(env_procs) if env_procs else None
+    if process_id is None:
+        env_pid = os.environ.get("LANGDETECT_TPU_PROCESS_ID")
+        process_id = int(env_pid) if env_pid else None
+    if coordinator_address is None and num_processes in (None, 1):
+        log_event(_log, "distributed.single_process")
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    log_event(
+        _log,
+        "distributed.initialized",
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+    )
+
+
+def host_shard(n_items: int) -> slice:
+    """This host's contiguous shard of an n_items-long work list."""
+    from .mesh import pad_to_multiple
+
+    p, k = jax.process_index(), jax.process_count()
+    per = pad_to_multiple(n_items, k) // k
+    return slice(p * per, min((p + 1) * per, n_items))
+
+
+def global_batch(local_batch: np.ndarray, sharding):
+    """Assemble a globally-sharded array from per-host local shards."""
+    return jax.make_array_from_process_local_data(sharding, local_batch)

@@ -1,0 +1,70 @@
+"""Device mesh construction and axis conventions.
+
+The reference delegates all distribution to Spark (SURVEY.md §2.2/§2.3). The
+TPU-native replacement is one ``jax.sharding.Mesh`` with two named axes:
+
+  * ``"data"`` — batch/document parallelism (the analog of Spark's
+    data-parallel map over partitions);
+  * ``"vocab"`` — model parallelism over the gram-id axis of the weight /
+    count tables (the analog of nothing in the reference — its model always
+    fit on one JVM — but required at 2^20-bucket × 176-language scale).
+
+All collectives are emitted by XLA from sharding annotations (GSPMD): counts
+aggregate with an all-reduce over ``data``; vocab-sharded tables keep their
+gathers local to the ``vocab`` shard. Nothing in this package hand-writes a
+collective for the SPMD path; ``sequence.py`` shows the explicit shard_map/
+ppermute formulation for the ring variant.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+VOCAB_AXIS = "vocab"
+
+
+def build_mesh(
+    data: int | None = None,
+    vocab: int = 1,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Mesh of shape (data, vocab) over the given (or all) devices.
+
+    ``data=None`` uses every remaining device on the data axis. On a single
+    chip this degenerates to a 1×1 mesh and all shardings become no-ops —
+    the same code path serves one chip and a slice.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if data is None:
+        if len(devices) % vocab:
+            raise ValueError(f"{len(devices)} devices not divisible by vocab={vocab}")
+        data = len(devices) // vocab
+    if data * vocab > len(devices):
+        raise ValueError(
+            f"mesh {data}x{vocab} needs {data * vocab} devices, have {len(devices)}"
+        )
+    grid = np.asarray(devices[: data * vocab]).reshape(data, vocab)
+    return Mesh(grid, (DATA_AXIS, VOCAB_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """[B, ...] arrays split over the data axis, replicated over vocab."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def vocab_sharding(mesh: Mesh) -> NamedSharding:
+    """[V, L] tables split over the vocab axis (rows), replicated over data."""
+    return NamedSharding(mesh, P(VOCAB_AXIS))
+
+
+def pad_to_multiple(n: int, k: int) -> int:
+    return -(-n // k) * k

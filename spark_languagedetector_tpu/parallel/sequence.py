@@ -1,0 +1,153 @@
+"""Sequence/context parallelism: one document spread across the mesh.
+
+The reference streams arbitrarily long documents through an O(1)-state
+iterator on one executor (SURVEY.md §5.7). The TPU analog must be
+fixed-shape AND unbounded, so a long document becomes a [D, C] grid of
+overlapping chunks (overlap = max(gram_lengths) - 1, ownership masks as in
+``ops.encoding.chunk_document``) laid out over the ``data`` axis; each device
+scores its chunks locally and the per-document reduction is a sum of
+[L]-vectors — the bag-of-grams analog of ring attention, except the
+reduction is a commutative psum, so no ring of partial softmaxes is needed.
+
+Two formulations are provided:
+
+  * :func:`score_long_document` — the idiomatic one: sharding annotations,
+    XLA emits the all-reduce.
+  * :func:`ring_score_chunks` — an explicit shard_map + ``ppermute`` ring
+    accumulation of the same sum. Numerically identical; exists for the
+    DCN-unfriendly topologies where a ring schedule overlaps compute with
+    neighbor transfers, and as the pattern native extensions build on.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.encoding import chunk_document
+from ..ops.score import score_batch
+from ..ops.vocab import VocabSpec
+from .mesh import DATA_AXIS, batch_sharding, pad_to_multiple, replicated
+
+
+def chunk_grid(
+    doc: bytes, num_shards: int, chunk_size: int, gram_lengths: tuple[int, ...]
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Lay one document out as [num_chunks_padded, chunk_size] rows plus
+    lengths and per-row owned-window limits, padded to a multiple of
+    ``num_shards`` rows so the grid shards evenly over the data axis."""
+    overlap = max(gram_lengths) - 1
+    parts = chunk_document(doc, chunk_size, overlap)
+    stride = chunk_size - overlap
+    rows = len(parts)
+    padded_rows = pad_to_multiple(rows, num_shards)
+    batch = np.zeros((padded_rows, chunk_size), dtype=np.uint8)
+    lengths = np.zeros(padded_rows, dtype=np.int32)
+    limits = np.zeros(padded_rows, dtype=np.int32)
+    for i, part in enumerate(parts):
+        batch[i, : len(part)] = np.frombuffer(part, dtype=np.uint8)
+        lengths[i] = len(part)
+        limits[i] = stride if i < rows - 1 else chunk_size
+    return batch, lengths, limits
+
+
+@partial(jax.jit, static_argnames=("spec", "mesh_static"))
+def _long_doc_score_jit(b, l, lim, w, ids, *, spec, mesh_static):
+    per_chunk = score_batch(
+        b, l, w, ids if (ids is not None and ids.size) else None,
+        spec=spec, window_limit=lim,
+    )
+    return per_chunk.sum(axis=0)  # cross-shard sum → GSPMD all-reduce
+
+
+def make_long_doc_scorer(mesh: Mesh, spec: VocabSpec, chunk_size: int = 8192):
+    """Compile-once scorer for arbitrarily long single documents.
+
+    Returns ``fn(doc: bytes, weights, sorted_ids|None) -> np.ndarray [L]``.
+    The jit cache is keyed on (spec, mesh) — repeated calls with different
+    documents reuse the compiled executables per padded grid shape.
+    """
+    n_data = mesh.shape[DATA_AXIS]
+    b_shard, rep = batch_sharding(mesh), replicated(mesh)
+
+    def score(doc: bytes, weights, sorted_ids=None) -> np.ndarray:
+        batch, lengths, limits = chunk_grid(doc, n_data, chunk_size, spec.gram_lengths)
+        args = [
+            jax.device_put(batch, b_shard),
+            jax.device_put(lengths, b_shard),
+            jax.device_put(limits, b_shard),
+            jax.device_put(weights, rep),
+        ]
+        ids = None if sorted_ids is None else jax.device_put(sorted_ids, rep)
+        return np.asarray(
+            _long_doc_score_jit(*args, ids, spec=spec, mesh_static=mesh)
+        )
+
+    return score
+
+
+def score_long_document(
+    doc: bytes,
+    weights,
+    sorted_ids,
+    spec: VocabSpec,
+    mesh: Mesh,
+    chunk_size: int = 8192,
+) -> np.ndarray:
+    """Exact [L] score of one document of any length, computed across the
+    mesh's data axis. Thin wrapper over :func:`make_long_doc_scorer`; the
+    underlying computation is compiled once per (spec, mesh, grid shape)."""
+    return make_long_doc_scorer(mesh, spec, chunk_size)(doc, weights, sorted_ids)
+
+
+def ring_score_chunks(
+    batch: jnp.ndarray,
+    lengths: jnp.ndarray,
+    limits: jnp.ndarray,
+    weights: jnp.ndarray,
+    sorted_ids: jnp.ndarray | None,
+    spec: VocabSpec,
+    mesh: Mesh,
+) -> jnp.ndarray:
+    """Explicit ring accumulation of per-shard chunk scores via ppermute.
+
+    Each of the D data shards scores its local chunk rows, then the partial
+    [L] sums travel the ring D-1 hops, accumulating at every stop — the
+    skeleton of ring attention with the softmax algebra replaced by a plain
+    sum. Returns the total [L], replicated on every shard.
+    """
+    n_data = mesh.shape[DATA_AXIS]
+    axis = DATA_AXIS
+
+    def shard_fn(b, l, lim, w, ids):
+        local = score_batch(
+            b, l, w, ids if ids.size else None, spec=spec, window_limit=lim
+        ).sum(axis=0)
+
+        def hop(i, carry):
+            acc, moving = carry
+            moving = jax.lax.ppermute(
+                moving,
+                axis,
+                perm=[(j, (j + 1) % n_data) for j in range(n_data)],
+            )
+            return acc + moving, moving
+
+        acc, _ = jax.lax.fori_loop(0, n_data - 1, hop, (local, local))
+        return acc[None, :]
+
+    ids_arr = sorted_ids if sorted_ids is not None else jnp.zeros(0, jnp.int32)
+    fn = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(), P()),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    per_shard_totals = fn(batch, lengths, limits, weights, ids_arr)  # [D, L]
+    # Every shard now holds the full sum; take shard 0's copy.
+    return per_shard_totals[0]

@@ -1,0 +1,162 @@
+"""SPMD scoring and fit over a device mesh (jit + GSPMD shardings).
+
+The distributed formulation of the two hot paths (SURVEY.md §5.8, §7.2
+"dist"): annotate input/output shardings on the existing single-device ops
+and let XLA insert the collectives —
+
+  * **scoring**: batch split over ``data``; weight table replicated (small
+    profiles ride ICI broadcast once) or split over ``vocab`` (2^20-bucket
+    tables), where the gather of a window's weight row becomes a local-shard
+    gather + all-reduce emitted by GSPMD;
+  * **fit**: every device scatter-counts its document shard into a dense
+    [V, L] table; the ``data``-axis reduction is a psum XLA inserts because
+    the output is required replicated (or vocab-sharded, in which case it
+    becomes a reduce-scatter). Weighting and per-language top-k stay on
+    device, sharded over ``vocab``/
+    replicated respectively.
+
+This mirrors the Spark training pipeline's shuffles (groupByKey ×3,
+LanguageDetector.scala:52-132) with exactly one collective.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import fit_tpu
+from ..ops.score import score_batch
+from ..ops.vocab import VocabSpec
+from .mesh import DATA_AXIS, VOCAB_AXIS, batch_sharding, replicated, vocab_sharding
+
+
+def make_sharded_scorer(
+    mesh: Mesh,
+    spec: VocabSpec,
+    *,
+    shard_vocab: bool = False,
+    block: int = 1024,
+):
+    """jit-compiled scorer with mesh shardings baked in.
+
+    Returns ``fn(batch [B,S] u8, lengths [B] i32, weights, sorted_ids|None)
+    -> scores [B,L] f32`` with B divisible by the data-axis size.
+    """
+    w_sharding = vocab_sharding(mesh) if shard_vocab else replicated(mesh)
+    in_shardings = (
+        batch_sharding(mesh),  # batch
+        batch_sharding(mesh),  # lengths
+        w_sharding,  # weights
+        replicated(mesh),  # sorted_ids (kept replicated: binary search is cheap)
+    )
+
+    @partial(
+        jax.jit,
+        in_shardings=in_shardings,
+        out_shardings=batch_sharding(mesh),
+        static_argnames=(),
+    )
+    def scorer(batch, lengths, weights, sorted_ids):
+        return score_batch(
+            batch, lengths, weights, sorted_ids, spec=spec, block=block
+        )
+
+    def scorer_no_ids(batch, lengths, weights):
+        # hashed mode: no sorted-id vector
+        return scorer(batch, lengths, weights, jnp.zeros(0, jnp.int32))
+
+    return scorer if spec.mode == "exact" else scorer_no_ids
+
+
+def make_sharded_fit_step(
+    mesh: Mesh,
+    spec: VocabSpec,
+    num_langs: int,
+    *,
+    shard_vocab: bool = True,
+):
+    """jit-compiled distributed fit accumulation step.
+
+    ``fn(batch [B,S], lengths [B], lang_ids [B], counts_acc [V,L])
+    -> counts_acc'`` — batch sharded over ``data``, the accumulator sharded
+    over ``vocab`` (or replicated). The cross-device count reduction is the
+    collective GSPMD derives from the output sharding.
+    """
+    acc_sharding = vocab_sharding(mesh) if shard_vocab else replicated(mesh)
+
+    @partial(
+        jax.jit,
+        in_shardings=(
+            batch_sharding(mesh),
+            batch_sharding(mesh),
+            batch_sharding(mesh),
+            acc_sharding,
+        ),
+        out_shardings=acc_sharding,
+    )
+    def fit_step(batch, lengths, lang_ids, counts_acc):
+        return fit_tpu.fit_dense_step(
+            batch, lengths, lang_ids, counts_acc, spec=spec, num_langs=num_langs
+        )
+
+    return fit_step
+
+
+def make_sharded_finalize(
+    mesh: Mesh,
+    *,
+    profile_size: int,
+    weight_mode: str = "parity",
+    shard_vocab: bool = True,
+):
+    """jit-compiled profile finalization: counts [V,L] → (weights [V,L],
+    top-k row ids [L,k]) with the table sharded over ``vocab``.
+
+    ``lax.top_k`` over a vocab-sharded column is handled by GSPMD as
+    local top-k + cross-shard merge.
+    """
+    acc_sharding = vocab_sharding(mesh) if shard_vocab else replicated(mesh)
+
+    @partial(
+        jax.jit,
+        in_shardings=(acc_sharding,),
+        out_shardings=(acc_sharding, replicated(mesh)),
+        static_argnames=("k",),
+    )
+    def finalize(counts, *, k=profile_size):
+        weights = fit_tpu.weights_from_counts(counts, weight_mode=weight_mode)
+        top_rows = fit_tpu.top_k_rows(weights, k=k)
+        return weights, top_rows
+
+    return finalize
+
+
+def training_step(
+    mesh: Mesh,
+    spec: VocabSpec,
+    num_langs: int,
+    profile_size: int,
+    *,
+    shard_vocab: bool = True,
+    weight_mode: str = "parity",
+):
+    """One full distributed training step (count → weight → top-k), jitted
+    end-to-end over the mesh. This is the step ``__graft_entry__.
+    dryrun_multichip`` executes."""
+    fit_step = make_sharded_fit_step(mesh, spec, num_langs, shard_vocab=shard_vocab)
+    finalize = make_sharded_finalize(
+        mesh,
+        profile_size=profile_size,
+        weight_mode=weight_mode,
+        shard_vocab=shard_vocab,
+    )
+
+    def step(batch, lengths, lang_ids, counts_acc):
+        counts = fit_step(batch, lengths, lang_ids, counts_acc)
+        weights, top_rows = finalize(counts)
+        return counts, weights, top_rows
+
+    return step

@@ -1,0 +1,154 @@
+"""Mesh/sharding layer on the 8-virtual-device CPU backend: distributed
+scoring and fit must agree bit-for-bit with the single-device ops."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_languagedetector_tpu.models.profile import GramProfile
+from spark_languagedetector_tpu.ops import fit_tpu
+from spark_languagedetector_tpu.ops.encoding import pad_batch, texts_to_bytes
+from spark_languagedetector_tpu.ops.score import score_batch
+from spark_languagedetector_tpu.ops.vocab import EXACT, HASHED, VocabSpec
+from spark_languagedetector_tpu.parallel import mesh as mesh_lib
+from spark_languagedetector_tpu.parallel import sequence as seq_lib
+from spark_languagedetector_tpu.parallel import sharded as sharded_lib
+
+from .oracle import scores_oracle
+
+LANGS = ("de", "en")
+GRAM_MAP = {b"ab": [1.0, 0.0], b"bc": [0.5, 0.5], b"abc": [0.0, 2.0]}
+
+
+@pytest.fixture(scope="module")
+def mesh8(eight_devices):
+    return mesh_lib.build_mesh(data=4, vocab=2)
+
+
+def _profile():
+    return GramProfile.from_gram_map(GRAM_MAP, LANGS, (2, 3))
+
+
+def test_build_mesh_shapes(eight_devices):
+    m = mesh_lib.build_mesh()
+    assert m.shape[mesh_lib.DATA_AXIS] == 8
+    m2 = mesh_lib.build_mesh(data=2, vocab=4)
+    assert m2.shape == {"data": 2, "vocab": 4}
+    with pytest.raises(ValueError):
+        mesh_lib.build_mesh(data=16, vocab=1)
+
+
+def test_sharded_scorer_matches_single_device(mesh8):
+    profile = _profile()
+    weights, sorted_ids = profile.device_arrays()
+    scorer = sharded_lib.make_sharded_scorer(mesh8, profile.spec)
+    texts = ["abcabc", "bcbc", "zzz", "", "ab", "abcbcab", "b", "cab"]
+    batch, lengths = pad_batch(texts_to_bytes(texts), pad_to=16)
+    got = np.asarray(scorer(batch, lengths, weights, sorted_ids))
+    want = np.asarray(
+        score_batch(batch, lengths, weights, sorted_ids, spec=profile.spec)
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    for t, row in zip(texts, got):
+        np.testing.assert_allclose(
+            row, scores_oracle(t, GRAM_MAP, 2, [2, 3]), rtol=1e-5, atol=1e-7
+        )
+
+
+def test_sharded_fit_step_matches_single_device(mesh8):
+    spec = VocabSpec(HASHED, (1, 2), hash_bits=8)
+    fit_step = sharded_lib.make_sharded_fit_step(mesh8, spec, 2)
+    texts = ["abab", "bcbc", "xyxy", "zz", "a", "", "abc", "bca"]
+    batch, lengths = pad_batch(texts_to_bytes(texts), pad_to=8)
+    lang_ids = np.asarray([0, 0, 1, 1, 0, 1, 0, 1], dtype=np.int32)
+    acc = jnp.zeros((spec.id_space_size, 2), dtype=jnp.int32)
+    got = np.asarray(fit_step(batch, lengths, lang_ids, acc))
+    want = np.asarray(
+        fit_tpu.gram_counts_dense(batch, lengths, lang_ids, spec=spec, num_langs=2)
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_full_training_step_on_mesh(mesh8):
+    spec = VocabSpec(HASHED, (1, 2), hash_bits=8)
+    step = sharded_lib.training_step(mesh8, spec, 2, profile_size=4)
+    texts = ["abab", "bcbc", "xyxy", "zz", "a", "q", "abc", "bca"]
+    batch, lengths = pad_batch(texts_to_bytes(texts), pad_to=8)
+    lang_ids = np.asarray([0, 0, 1, 1, 0, 1, 0, 1], dtype=np.int32)
+    acc = jnp.zeros((spec.id_space_size, 2), dtype=jnp.int32)
+    counts, weights, top_rows = step(batch, lengths, lang_ids, acc)
+    assert counts.shape == (256, 2)
+    assert weights.shape == (256, 2)
+    assert top_rows.shape == (2, 4)
+    # Weight formula parity on the dense path.
+    w_host = np.asarray(weights)
+    c_host = np.asarray(counts)
+    present = c_host > 0
+    nlangs = present.sum(axis=1, keepdims=True)
+    expected = np.log1p(np.where(nlangs > 0, present / np.maximum(nlangs, 1), 0))
+    np.testing.assert_allclose(w_host, expected, rtol=1e-6)
+
+
+def test_dense_fit_matches_host_fit_exact_small():
+    """Device dense fit == host sparse fit on an exact bigram vocab."""
+    from spark_languagedetector_tpu.ops import fit as fit_host
+
+    spec = VocabSpec(EXACT, (1, 2))
+    texts = ["abab", "bcbc", "xy", "z"]
+    docs = texts_to_bytes(texts)
+    lang_idx = np.asarray([0, 0, 1, 1])
+    batch, lengths = pad_batch(docs, pad_to=8)
+    dense = np.asarray(
+        fit_tpu.gram_counts_dense(
+            batch, lengths, lang_idx.astype(np.int32), spec=spec, num_langs=2
+        )
+    )
+    sparse = fit_host.extract_gram_counts(docs, lang_idx, 2, spec)
+    dense_from_sparse = np.zeros_like(dense)
+    dense_from_sparse[sparse.ids, sparse.langs] = sparse.counts
+    np.testing.assert_array_equal(dense, dense_from_sparse)
+
+
+def test_score_long_document_across_mesh(mesh8):
+    profile = _profile()
+    weights, sorted_ids = profile.device_arrays()
+    rng = np.random.default_rng(3)
+    text = "".join(rng.choice(list("abcz")) for _ in range(3000))
+    doc = text.encode("utf-8")
+    got = seq_lib.score_long_document(
+        doc, weights, sorted_ids, profile.spec, mesh8, chunk_size=256
+    )
+    expected = scores_oracle(text, GRAM_MAP, 2, [2, 3])
+    np.testing.assert_allclose(got, expected, rtol=1e-5)
+
+
+def test_ring_scoring_matches_psum_path(mesh8):
+    profile = _profile()
+    weights, sorted_ids = profile.device_arrays()
+    rng = np.random.default_rng(5)
+    text = "".join(rng.choice(list("abc")) for _ in range(2000))
+    doc = text.encode("utf-8")
+    batch, lengths, limits = seq_lib.chunk_grid(
+        doc, mesh8.shape["data"], 256, profile.spec.gram_lengths
+    )
+    total = np.asarray(
+        seq_lib.ring_score_chunks(
+            jnp.asarray(batch),
+            jnp.asarray(lengths),
+            jnp.asarray(limits),
+            weights,
+            sorted_ids,
+            profile.spec,
+            mesh8,
+        )
+    )
+    expected = scores_oracle(text, GRAM_MAP, 2, [2, 3])
+    np.testing.assert_allclose(total, expected, rtol=1e-5)
+
+
+def test_host_shard_covers_everything():
+    from spark_languagedetector_tpu.parallel.distributed import host_shard
+
+    s = host_shard(10)
+    assert s == slice(0, 10)  # single-process: everything

@@ -84,10 +84,22 @@ class BatchRunner:
             self.weights = jax.device_put(self.weights, self.device)
             if self.sorted_ids is not None:
                 self.sorted_ids = jax.device_put(self.sorted_ids, self.device)
+        # Trigger the one-time native-library build here, not inside the
+        # first score() call's timed hot loop.
+        from .. import native
+
+        native.available()
 
     @property
     def max_chunk(self) -> int:
         return self.length_buckets[-1]
+
+    @staticmethod
+    def _pack(batch_docs, pad_to: int):
+        """Padded packing: native C++ loader (falls back to numpy internally)."""
+        from .. import native
+
+        return native.pack_batch(batch_docs, pad_to)
 
     def score(self, byte_docs: Sequence[bytes]) -> np.ndarray:
         """float32 [N, L] scores in input order (exact over any doc length)."""
@@ -129,7 +141,7 @@ class BatchRunner:
                     max((len(d) for d in batch_docs), default=1),
                     self.length_buckets,
                 )
-                batch, lengths = pad_batch(batch_docs, pad_to=pad_to)
+                batch, lengths = self._pack(batch_docs, pad_to)
                 window_limit = np.asarray([limits[k] for k in sel], dtype=np.int32)
                 if self.device is not None:
                     batch = jax.device_put(batch, self.device)

@@ -1,0 +1,163 @@
+"""Native runtime extensions (C++ via ctypes; no pybind11 in this image).
+
+Builds ``packer.cpp`` into ``libpacker.so`` on first use (g++ -O3, cached
+next to the source) and exposes:
+
+  * :func:`pack_batch` — multithreaded ragged-bytes → padded uint8 [B, S]
+    packing (drop-in replacement for ``ops.encoding.pad_batch``'s Python
+    loop; the host-side hot path at benchmark throughput);
+  * :func:`clean_bytes` — byte-level strip+squash (ASCII whitespace only;
+    the str-level ``SpecialCharPreprocessor`` additionally squashes Unicode
+    whitespace like NBSP and remains the semantics owner);
+  * :func:`ascii_lower` — ASCII-range lowercasing.
+
+Every entry point has a pure-Python fallback: if no compiler is available or
+the build fails, ``available()`` is False and callers transparently use the
+numpy paths (correctness never depends on the native library; tests assert
+equivalence whenever it is present).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+import numpy as np
+
+from ..utils.logging import get_logger, log_event
+
+_log = get_logger("native")
+
+_HERE = Path(__file__).resolve().parent
+_SRC = _HERE / "packer.cpp"
+_SO = _HERE / "libpacker.so"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    # Compile to a process-unique temp name and rename into place: rename is
+    # atomic on POSIX, so a concurrent process never dlopens a half-written
+    # .so (it either sees the old file, nothing, or the complete new one).
+    tmp = _SO.with_suffix(f".tmp.{os.getpid()}.so")
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+        "-o", str(tmp), str(_SRC), "-lpthread",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return True
+    except (subprocess.CalledProcessError, FileNotFoundError, subprocess.TimeoutExpired) as e:
+        detail = getattr(e, "stderr", b"")
+        log_event(
+            _log, "native.build_failed",
+            error=str(e), stderr=detail.decode() if detail else "",
+        )
+        tmp.unlink(missing_ok=True)
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(str(_SO))
+        except OSError as e:
+            log_event(_log, "native.load_failed", error=str(e))
+            return None
+        lib.pack_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+        ]
+        lib.pack_batch.restype = None
+        lib.clean_bytes.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+        ]
+        lib.clean_bytes.restype = ctypes.c_int64
+        lib.ascii_lower.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.ascii_lower.restype = None
+        _lib = lib
+        log_event(_log, "native.loaded", path=str(_SO))
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def pack_batch(
+    byte_docs, pad_to: int, n_threads: int | None = None
+) -> tuple[np.ndarray, np.ndarray]:
+    """Native padded packing: list[bytes] → (uint8 [B, pad_to], int32 [B]).
+
+    Falls back to the numpy implementation when the library is unavailable.
+    """
+    lib = _load()
+    if lib is None:
+        from ..ops.encoding import pad_batch as py_pad
+
+        return py_pad(byte_docs, pad_to=pad_to)
+
+    n = len(byte_docs)
+    # Hand each bytes object's buffer to C directly (no staging concatenation
+    # copy): the C-side memcpy is the only host copy of the data.
+    ptrs = (ctypes.c_char_p * n)(*byte_docs)
+    lens = np.fromiter((len(d) for d in byte_docs), dtype=np.int64, count=n)
+    out = np.empty((n, pad_to), dtype=np.uint8)
+    out_lens = np.empty(n, dtype=np.int32)
+    if n_threads is None:
+        n_threads = min(8, os.cpu_count() or 1)
+    lib.pack_batch(
+        ptrs,
+        lens.ctypes.data_as(ctypes.c_void_p),
+        n,
+        pad_to,
+        out.ctypes.data_as(ctypes.c_void_p),
+        out_lens.ctypes.data_as(ctypes.c_void_p),
+        n_threads,
+    )
+    return out, out_lens
+
+
+def clean_bytes(data: bytes) -> bytes:
+    """Byte-level strip+squash (ASCII whitespace only — Unicode whitespace
+    such as NBSP passes through; use ``SpecialCharPreprocessor`` for full
+    str-level semantics). Falls back to a Python byte-regex when unbuilt."""
+    lib = _load()
+    if lib is None:
+        import re
+
+        sym = re.compile(rb'[/_\[\]*()%^&@$#:|{}<>~`"\\]')
+        ws = re.compile(rb"\s+")
+        return ws.sub(b" ", sym.sub(b"", data))
+    src = np.frombuffer(data, dtype=np.uint8)
+    out = np.empty(len(data), dtype=np.uint8)
+    n = lib.clean_bytes(
+        src.ctypes.data_as(ctypes.c_void_p), len(data),
+        out.ctypes.data_as(ctypes.c_void_p),
+    )
+    return out[:n].tobytes()
+
+
+def ascii_lower(data: bytes) -> bytes:
+    """Native ASCII lowercasing; multi-byte UTF-8 untouched."""
+    lib = _load()
+    buf = np.frombuffer(data, dtype=np.uint8).copy()
+    if lib is None:
+        mask = (buf >= 65) & (buf <= 90)
+        buf[mask] += 32
+        return buf.tobytes()
+    lib.ascii_lower(buf.ctypes.data_as(ctypes.c_void_p), len(buf))
+    return buf.tobytes()

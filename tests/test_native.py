@@ -1,0 +1,57 @@
+"""Native C++ packer: builds in this image and matches the Python paths."""
+
+import numpy as np
+import pytest
+
+from spark_languagedetector_tpu import native
+from spark_languagedetector_tpu.ops.encoding import pad_batch
+
+
+@pytest.fixture(scope="module", autouse=True)
+def require_native():
+    assert native.available(), "native library must build in this image (g++ baked in)"
+
+
+def test_pack_batch_matches_python():
+    rng = np.random.default_rng(0)
+    docs = [bytes(rng.integers(0, 256, rng.integers(0, 50), dtype=np.uint8)) for _ in range(37)]
+    docs.append(b"")
+    got_b, got_l = native.pack_batch(docs, pad_to=64)
+    want_b, want_l = pad_batch(docs, pad_to=64)
+    np.testing.assert_array_equal(got_b, want_b)
+    np.testing.assert_array_equal(got_l, want_l)
+
+
+def test_pack_batch_truncates_to_pad():
+    got_b, got_l = native.pack_batch([b"x" * 100], pad_to=10)
+    assert got_l.tolist() == [10]
+    assert got_b.shape == (1, 10)
+    assert bytes(got_b[0]) == b"x" * 10
+
+
+def test_clean_bytes_matches_preprocessor():
+    from spark_languagedetector_tpu import SpecialCharPreprocessor, Table
+
+    raw = 'a/b_c [d]  e\t\tf(g) "h"\\'
+    native_out = native.clean_bytes(raw.encode()).decode()
+    table_out = (
+        SpecialCharPreprocessor()
+        .transform(Table({"fulltext": [raw]}))
+        .column("fulltext")[0]
+    )
+    assert native_out == table_out
+
+
+def test_clean_bytes_edge_cases():
+    assert native.clean_bytes(b"") == b""
+    assert native.clean_bytes(b"   ") == b" "
+    assert native.clean_bytes(b"abc") == b"abc"
+    # multi-byte UTF-8 passes through (all stripped chars < 0x80)
+    s = "schön grüß".encode("utf-8")
+    assert native.clean_bytes(s) == s
+
+
+def test_ascii_lower():
+    assert native.ascii_lower(b"Hello WORLD 123") == b"hello world 123"
+    s = "ÄÖÜ".encode("utf-8")
+    assert native.ascii_lower(s) == s  # non-ASCII untouched

@@ -1,0 +1,81 @@
+"""Streaming micro-batch loop tests."""
+
+import pytest
+
+from spark_languagedetector_tpu import LanguageDetectorModel
+from spark_languagedetector_tpu.stream.microbatch import (
+    StreamingQuery,
+    kafka_source,
+    memory_source,
+    run_stream,
+)
+
+
+def _model():
+    return LanguageDetectorModel.from_gram_map(
+        {b"ab": [1.0, 0.0], b"xy": [0.0, 1.0]}, [2], ["a", "x"]
+    )
+
+
+def test_stream_scores_all_batches_in_order():
+    rows = [{"fulltext": "ababab"}, {"fulltext": "xyxy"}] * 5
+    outputs = []
+    query = run_stream(
+        _model(),
+        memory_source(rows, batch_rows=3),
+        sink=lambda t: outputs.extend(t.column("lang").tolist()),
+    )
+    assert query.batches == 4  # ceil(10 / 3)
+    assert query.rows == 10
+    assert outputs == ["a", "x"] * 5
+    assert query.rows_per_second > 0
+
+
+def test_stream_max_batches_limits_consumption():
+    rows = [{"fulltext": "ab"}] * 100
+    seen = []
+    query = run_stream(
+        _model(),
+        memory_source(rows, batch_rows=10),
+        sink=lambda t: seen.append(t.num_rows),
+        max_batches=3,
+    )
+    assert query.batches == 3
+    assert seen == [10, 10, 10]
+
+
+def test_stream_retries_transient_failure_once():
+    rows = [{"fulltext": "ab"}] * 4
+    model = _model()
+    real_transform = model.transform
+    fails = {"left": 1}
+
+    def flaky(batch):
+        if fails["left"]:
+            fails["left"] -= 1
+            raise RuntimeError("transient device hiccup")
+        return real_transform(batch)
+
+    model.transform = flaky
+    query = run_stream(
+        model, memory_source(rows, 2), sink=lambda t: None
+    )
+    assert query.batches == 2
+    assert query.metrics.counters["retries"] == 1
+
+
+def test_stream_progress_callback():
+    rows = [{"fulltext": "ab"}] * 6
+    snapshots = []
+    run_stream(
+        _model(),
+        memory_source(rows, 2),
+        sink=lambda t: None,
+        on_progress=lambda q: snapshots.append((q.batches, q.last_batch_rows)),
+    )
+    assert snapshots == [(1, 2), (2, 2), (3, 2)]
+
+
+def test_kafka_source_gated_on_missing_dependency():
+    with pytest.raises(RuntimeError, match="kafka-python"):
+        next(kafka_source("topic", 10))

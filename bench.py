@@ -121,9 +121,11 @@ def main():
         t_base = time.perf_counter() - t0
     else:
         # Hashed mode has no byte-keyed map; baseline uses bucket dict.
-        w = model.profile.weights
-        nz = np.flatnonzero(np.abs(w).sum(axis=1))
-        bucket_map = {int(b): w[b].tolist() for b in nz}
+        compact = model.profile.compacted()
+        bucket_map = {
+            int(b): compact.weights[r].tolist()
+            for r, b in enumerate(compact.ids)
+        }
         spec = model.profile.spec
         t0 = time.perf_counter()
         base_scores = []

@@ -71,19 +71,39 @@ class BatchRunner:
     """
 
     weights: jnp.ndarray
-    sorted_ids: jnp.ndarray | None
+    lut: jnp.ndarray | None
     spec: VocabSpec
     batch_size: int = DEFAULT_BATCH_SIZE
     length_buckets: tuple[int, ...] = DEFAULT_LENGTH_BUCKETS
     block: int = score_ops.DEFAULT_BLOCK
     device: object | None = None  # jax device; None ⇒ process default
+    strategy: str = "auto"  # 'auto' | 'gather' | 'onehot'
     metrics: Metrics = field(default_factory=Metrics)
 
     def __post_init__(self):
         if self.device is not None:
             self.weights = jax.device_put(self.weights, self.device)
-            if self.sorted_ids is not None:
-                self.sorted_ids = jax.device_put(self.sorted_ids, self.device)
+            if self.lut is not None:
+                self.lut = jax.device_put(self.lut, self.device)
+        if self.strategy not in ("auto", "gather", "onehot"):
+            raise ValueError(
+                f"unknown strategy {self.strategy!r}; "
+                "expected 'auto', 'gather', or 'onehot'"
+            )
+        if self.strategy == "auto":
+            # One-hot MXU scoring (no gathers) when the vocab qualifies:
+            # exact grams ⊆ {1,2} over the dense table.
+            eligible = self.lut is None and score_ops.onehot_supported(
+                self.spec, self.weights.shape[0]
+            )
+            self.strategy = "onehot" if eligible else "gather"
+        if self.strategy == "onehot" and not score_ops.onehot_supported(
+            self.spec, self.weights.shape[0]
+        ):
+            raise ValueError(
+                "strategy='onehot' needs an exact vocab with gram lengths <= "
+                f"{score_ops.ONEHOT_MAX_N} and the dense weight table"
+            )
         # Trigger the one-time native-library build here, not inside the
         # first score() call's timed hot loop.
         from .. import native
@@ -142,30 +162,51 @@ class BatchRunner:
                     self.length_buckets,
                 )
                 batch, lengths = self._pack(batch_docs, pad_to)
-                window_limit = np.asarray([limits[k] for k in sel], dtype=np.int32)
+                batch_limits = [limits[k] for k in sel]
+                # Batches without chunked docs (the common case) skip the
+                # window-limit array entirely — one fewer host→device
+                # transfer and a simpler compiled program.
+                if all(lim == self.max_chunk for lim in batch_limits):
+                    window_limit = None
+                else:
+                    window_limit = np.asarray(batch_limits, dtype=np.int32)
                 if self.device is not None:
                     batch = jax.device_put(batch, self.device)
                     lengths = jax.device_put(lengths, self.device)
-                    window_limit = jax.device_put(window_limit, self.device)
-                else:
+                    if window_limit is not None:
+                        window_limit = jax.device_put(window_limit, self.device)
+                elif window_limit is not None:
                     window_limit = jnp.asarray(window_limit)
-                scores = score_ops.score_batch(
-                    batch,
-                    lengths,
-                    self.weights,
-                    self.sorted_ids,
-                    spec=self.spec,
-                    block=self.block,
-                    window_limit=window_limit,
-                )
-                # Async dispatch: keep packing while the device works.
+                if self.strategy == "onehot":
+                    scores = score_ops.score_batch_onehot(
+                        batch,
+                        lengths,
+                        self.weights,
+                        spec=self.spec,
+                        block=min(self.block, 1024),
+                        window_limit=window_limit,
+                    )
+                else:
+                    scores = score_ops.score_batch(
+                        batch,
+                        lengths,
+                        self.weights,
+                        self.lut,
+                        spec=self.spec,
+                        block=self.block,
+                        window_limit=window_limit,
+                    )
+                # Async dispatch: keep packing while the device works — and
+                # start the device→host copy as soon as the compute finishes
+                # (a cold fetch over a tunneled device costs ~100ms; the
+                # async prefetch overlaps it with the remaining batches).
+                scores.copy_to_host_async()
                 pending.append((sel, scores))
                 self.metrics.incr("chunks_scored", len(sel))
 
+            doc_idx_arr = np.asarray(doc_idx, dtype=np.int64)
             for sel, scores in pending:
-                host_scores = np.asarray(scores)
-                for row, k in enumerate(sel):
-                    out[doc_idx[k]] += host_scores[row]
+                np.add.at(out, doc_idx_arr[sel], np.asarray(scores))
 
         self.metrics.incr("docs_scored", N)
         log_event(

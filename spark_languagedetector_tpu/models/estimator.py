@@ -172,18 +172,11 @@ class LanguageDetector(_DetectorParams):
             self.get("languageProfileSize"),
             self.get("weightMode"),
         )
-        if spec.mode == HASHED:
-            # Densify: scoring indexes buckets directly.
-            dense = np.zeros((spec.id_space_size, len(supported)))
-            dense[ids] = weights
-            profile = GramProfile(
-                spec=spec, languages=tuple(supported), ids=np.zeros(0, np.int64),
-                weights=dense,
-            )
-        else:
-            profile = GramProfile(
-                spec=spec, languages=tuple(supported), ids=ids, weights=weights
-            )
+        # Both modes store the compact columnar form (sorted unique ids +
+        # weight rows); the device view picks dense-table vs LUT strategy.
+        profile = GramProfile(
+            spec=spec, languages=tuple(supported), ids=ids, weights=weights
+        )
         log_event(
             _log, "fit.done", rows=dataset.num_rows, grams=profile.num_grams,
             languages=len(supported),
@@ -297,10 +290,10 @@ class LanguageDetectorModel(HasInputCol, HasOutputCol):
 
     def _get_runner(self) -> BatchRunner:
         if self._runner is None:
-            weights, sorted_ids = self.profile.device_arrays()
+            weights, lut = self.profile.device_arrays()
             self._runner = BatchRunner(
                 weights=weights,
-                sorted_ids=sorted_ids,
+                lut=lut,
                 spec=self.profile.spec,
                 batch_size=self.get("batchSize"),
                 device=resolve_device(self.get("backend")),

@@ -3,9 +3,22 @@
 The reference's model state is ``Map[Seq[Byte], Array[Double]]`` — a JVM map
 from gram bytes to per-language log-weights
 (``/root/reference/src/main/.../LanguageDetectorModel.scala:179``). The
-TPU-native state is columnar: a sorted id vector plus a dense weight matrix
-(exact mode), or just a dense ``[V, L]`` bucket table (hashed mode). The map
-view is still offered for API/test parity (``gram_probabilities``).
+TPU-native state is columnar: a sorted id vector plus a compact weight matrix
+(both vocab modes), with hashed profiles also accepted in dense ``[V, L]``
+bucket-table form. The map view is still offered for API/test parity
+(``gram_probabilities``).
+
+Device view strategy (``device_arrays``): there is no TPU analog of the
+reference's pointer-chasing hash lookup, and binary search (``searchsorted``)
+lowers to a serial scan — so membership is resolved by *tables*:
+
+* when the dense ``[id_space, L]`` weight table fits a budget, window ids
+  index it directly (one gather, and the one-hot MXU strategy applies for
+  gram lengths ≤ 2);
+* otherwise a dense int32 ``[id_space]`` lookup table maps ids to rows of a
+  compact ``[G+1, L]`` table (row G zeros for misses) — two small gathers,
+  with the id_space capped at 2^24ish by VocabSpec (exact n ≤ 3) or
+  2^hash_bits (hashed).
 """
 
 from __future__ import annotations
@@ -18,16 +31,22 @@ import numpy as np
 
 from ..ops.vocab import EXACT, HASHED, VocabSpec
 
+# Dense [id_space, L] tables at or under this size are shipped whole; larger
+# ones go through the compact LUT path. 256MB ≈ the exact-trigram table at
+# L=3 (202MB) passing, the hashed 2^20 table at L=176 (738MB f32) compacting.
+DENSE_TABLE_BUDGET_BYTES = 256 * 1024 * 1024
+
 
 @dataclass(frozen=True)
 class GramProfile:
     """Immutable trained profile.
 
-    ``ids``: int64 [G] ascending gram ids (exact mode; empty for hashed).
-    ``weights``: float [G, L] (exact) or [V, L] (hashed) — no miss row; the
-    scoring-time zeros row is appended in the device view.
-    ``languages``: decision order — index i ⇒ ``languages[i]`` (the reference's
-    ``supportedLanguages(argmax)``).
+    ``ids``: int64 [G] ascending gram ids (compact form). A hashed profile
+    may instead be *dense*: ``ids`` empty and ``weights`` covering all
+    ``2^hash_bits`` buckets.
+    ``weights``: float [G, L] (compact) or [V, L] (dense hashed).
+    ``languages``: decision order — index i ⇒ ``languages[i]`` (the
+    reference's ``supportedLanguages(argmax)``).
     """
 
     spec: VocabSpec
@@ -36,19 +55,16 @@ class GramProfile:
     weights: np.ndarray
 
     def __post_init__(self):
-        if self.spec.mode == EXACT:
+        if self.is_dense:
+            if self.spec.mode == EXACT:
+                raise ValueError("exact profiles must be compact (ids + weights)")
+        else:
             if self.ids.shape[0] != self.weights.shape[0]:
                 raise ValueError(
                     f"ids/weights mismatch: {self.ids.shape} vs {self.weights.shape}"
                 )
             if len(self.ids) > 1 and not bool(np.all(np.diff(self.ids) > 0)):
-                raise ValueError("exact profile ids must be strictly ascending")
-        else:
-            if self.weights.shape[0] != self.spec.id_space_size:
-                raise ValueError(
-                    f"hashed weights must have {self.spec.id_space_size} rows, "
-                    f"got {self.weights.shape[0]}"
-                )
+                raise ValueError("profile ids must be strictly ascending")
         if self.weights.shape[1] != len(self.languages):
             raise ValueError(
                 f"weights have {self.weights.shape[1]} columns for "
@@ -56,31 +72,93 @@ class GramProfile:
             )
 
     @property
+    def is_dense(self) -> bool:
+        """True for the dense hashed bucket-table form."""
+        return (
+            self.spec.mode == HASHED
+            and self.ids.shape[0] == 0
+            and self.weights.shape[0] == self.spec.id_space_size
+        )
+
+    @property
     def num_languages(self) -> int:
         return len(self.languages)
 
     @property
     def num_grams(self) -> int:
-        return int(self.ids.shape[0]) if self.spec.mode == EXACT else int(
-            self.weights.shape[0]
+        return int(self.weights.shape[0]) if self.is_dense else int(self.ids.shape[0])
+
+    # -- form conversion -------------------------------------------------------
+    def compacted(self) -> "GramProfile":
+        """Compact form: nonzero rows only (no-op if already compact)."""
+        if not self.is_dense:
+            return self
+        nonzero = np.flatnonzero(np.abs(self.weights).sum(axis=1))
+        return GramProfile(
+            spec=self.spec,
+            languages=self.languages,
+            ids=nonzero.astype(np.int64),
+            weights=np.ascontiguousarray(self.weights[nonzero]),
         )
 
-    # -- device view -----------------------------------------------------------
-    def device_arrays(self, dtype=jnp.float32) -> tuple[jnp.ndarray, jnp.ndarray | None]:
-        """(weights_dev, sorted_ids_dev) ready for ``ops.score.score_batch``.
+    def _dense_table(self, dtype) -> np.ndarray:
+        if self.is_dense:
+            return np.asarray(self.weights, dtype=dtype)
+        table = np.zeros((self.spec.id_space_size, self.num_languages), dtype=dtype)
+        if len(self.ids):
+            table[self.ids] = self.weights
+        return table
 
-        Exact mode appends the zeros miss-row; ids go to int32 (the exact id
-        space is ≤ 2^25, int32-safe by VocabSpec's construction).
+    # -- device view -----------------------------------------------------------
+    def device_arrays(
+        self,
+        dtype=jnp.float32,
+        dense_budget_bytes: int = DENSE_TABLE_BUDGET_BYTES,
+    ) -> tuple[jnp.ndarray, jnp.ndarray | None]:
+        """(weights_dev, lut_dev) ready for ``ops.score.score_batch``.
+
+        ``lut_dev`` is None when the dense table fits ``dense_budget_bytes``
+        (direct indexing — and the one-hot MXU strategy becomes eligible);
+        otherwise an int32 [id_space] id→row table plus compact weights with
+        the zeros miss-row appended at row G.
         """
-        if self.spec.mode == EXACT:
-            w = np.concatenate(
-                [self.weights, np.zeros((1, self.num_languages), self.weights.dtype)]
-            )
-            return (
-                jnp.asarray(w, dtype=dtype),
-                jnp.asarray(self.ids.astype(np.int32)),
-            )
-        return jnp.asarray(self.weights, dtype=dtype), None
+        itemsize = jnp.dtype(dtype).itemsize
+        L = self.num_languages
+        V = self.spec.id_space_size
+        dense_bytes = V * L * itemsize
+        # LUT (int32 [V]) + compact weights — what the alternative costs.
+        compact_bytes = V * 4 + (self.num_grams + 1) * L * itemsize
+        use_dense = dense_bytes <= dense_budget_bytes and (
+            # Short exact grams: the table is small and enables the
+            # gather-free one-hot MXU strategy — always worth shipping dense.
+            (self.spec.mode == EXACT and max(self.spec.gram_lengths) <= 2)
+            # Otherwise only when dense isn't grossly larger than compact
+            # (a tiny profile over a 2^24 exact-trigram id space would
+            # otherwise ship hundreds of MB of zeros).
+            or dense_bytes <= 4 * compact_bytes
+        )
+        if use_dense:
+            np_dtype = np.float64 if itemsize > 4 else np.float32
+            return jnp.asarray(self._dense_table(np_dtype), dtype=dtype), None
+        compact = self.compacted()
+        G = compact.num_grams
+        w = np.concatenate(
+            [compact.weights, np.zeros((1, L), compact.weights.dtype)]
+        )
+        lut = np.full(self.spec.id_space_size, G, dtype=np.int32)
+        lut[compact.ids] = np.arange(G, dtype=np.int32)
+        return jnp.asarray(w, dtype=dtype), jnp.asarray(lut)
+
+    def host_arrays(self) -> tuple[np.ndarray, np.ndarray | None]:
+        """(weights, sorted_ids) for ``ops.score.score_batch_numpy``: compact
+        weights + miss row + ascending ids (searchsorted membership — fast on
+        CPU), or the dense table + None for dense hashed profiles."""
+        if self.is_dense:
+            return self.weights, None
+        w = np.concatenate(
+            [self.weights, np.zeros((1, self.num_languages), self.weights.dtype)]
+        )
+        return w, self.ids
 
     # -- map view (reference API parity) --------------------------------------
     @cached_property

@@ -2,17 +2,27 @@
 
 Replaces the reference's per-row hot loop — per-window JVM hash-map lookup +
 ``BLAS.axpy`` accumulate + Breeze argmax
-(``/root/reference/src/main/.../LanguageDetectorModel.scala:131-156``) — with a
-fixed-shape, jit-compiled pipeline:
+(``/root/reference/src/main/.../LanguageDetectorModel.scala:131-156``) — with
+fixed-shape, jit-compiled pipelines. Three TPU strategies, picked by the
+profile's device view (``models.profile.GramProfile.device_arrays``):
 
-    bytes [B, S] ──window_ids──▶ ids [B, W] ──membership──▶ rows [B, W]
-      ──gather W[rows] · mask, block-scan──▶ scores [B, L] ──argmax──▶ [B]
+* **dense gather** (``lut=None``): the weight table covers the whole id space
+  ``[V, L]`` and window ids index it directly — one gather per window.
+* **LUT gather** (``lut`` int32 ``[V]``): a dense id→row lookup table maps
+  window ids into a compact ``[G+1, L]`` table (row G = zeros miss row).
+  Replaces binary-search membership — ``jnp.searchsorted`` lowers to a
+  serial scan on TPU and measured ~40ms per [256, 2048] batch, vs ~4ms for
+  the LUT gather.
+* **one-hot MXU** (:func:`score_batch_onehot`): for exact vocabularies with
+  gram lengths ⊆ {1, 2}, scoring needs no gathers at all — the bigram
+  histogram of a window block is the outer product of the two byte one-hots,
+  a ``[W, 256]ᵀ @ [W, 256]`` batched matmul on the MXU, and scores are
+  ``hist @ W``. This is the north star's "histogram × log-prob matrix as one
+  matmul" (BASELINE.json) in its purest form.
 
-Exact mode resolves membership with a branchless binary search against the
-model's sorted id vector (misses hit a zeros row). Hashed mode indexes the
-dense ``[V, L]`` weight table directly. The window axis is processed in
-blocks under ``lax.scan`` so peak memory is ``B·block·L`` regardless of
-document length, and XLA fuses the gather+mask+reduce per block.
+The window axis is processed in blocks under ``lax.scan`` so peak memory is
+``B·block·L`` (gather) or ``B·block·256`` (one-hot) regardless of document
+length, and XLA fuses the compare/gather + mask + reduce per block.
 
 Semantics parity (SURVEY.md §2.9): unknown grams contribute zero; an all-miss
 document scores all-zeros and argmax resolves to index 0 — the reference's Q6
@@ -35,38 +45,20 @@ from .vocab import EXACT, HASHED, VocabSpec, partial_window_ids, window_ids
 DEFAULT_BLOCK = 1024
 
 
-def _lookup_rows_exact(ids: jnp.ndarray, sorted_ids: jnp.ndarray) -> jnp.ndarray:
-    """ids [B, W] int32 → row indices into the weight matrix [G+1, L].
-
-    Binary search + equality check; misses map to row G (the zeros row).
-    An empty profile (G == 0) maps everything to the miss row.
-    """
-    G = sorted_ids.shape[0]
-    if G == 0:
-        return jnp.zeros_like(ids)
-    pos = jnp.searchsorted(sorted_ids, ids, side="left").astype(jnp.int32)
-    pos_c = jnp.minimum(pos, G - 1)
-    hit = sorted_ids[pos_c] == ids
-    return jnp.where(hit, pos_c, G)
-
-
 def _partial_window_rows(
     batch: jnp.ndarray,
     lengths: jnp.ndarray,
     n: int,
     window0_ids: jnp.ndarray,
     spec: VocabSpec,
-    sorted_ids: jnp.ndarray | None,
+    lut: jnp.ndarray | None,
     miss_row: int,
 ) -> jnp.ndarray:
     """Row indices for the single partial window of docs with len < n.
     Docs with len == 0 get the miss row (Scala ``sliding`` over an empty
     collection emits nothing)."""
     short_ids = partial_window_ids(batch, lengths, n, window0_ids, spec)
-    if spec.mode == EXACT:
-        rows = _lookup_rows_exact(short_ids[:, None], sorted_ids)[:, 0]
-    else:
-        rows = short_ids
+    rows = short_ids if lut is None else lut[short_ids]
     return jnp.where(lengths > 0, rows, miss_row)
 
 
@@ -99,19 +91,22 @@ def score_batch(
     batch: jnp.ndarray,
     lengths: jnp.ndarray,
     weights: jnp.ndarray,
-    sorted_ids: jnp.ndarray | None,
+    lut: jnp.ndarray | None,
     *,
     spec: VocabSpec,
     block: int = DEFAULT_BLOCK,
     window_limit: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
-    """Scores for a padded batch.
+    """Scores for a padded batch (gather strategies).
 
     Args:
       batch: uint8 [B, S] zero-padded document bytes.
       lengths: int32 [B] true byte lengths (≤ S).
-      weights: float [G+1, L] (exact; row G zeros) or [V, L] (hashed).
-      sorted_ids: int32 [G] ascending gram ids (exact mode) or None.
+      weights: float [V, L] dense over the id space (``lut`` None) or
+        [G+1, L] compact with a zeros miss row at G (``lut`` given).
+      lut: optional int32 [V] id→row table; unlearned ids map to row G.
+        A size-0 array is treated like None (dense direct indexing) so the
+        sharded callers can pass a sentinel instead of a None pytree leaf.
       spec: vocabulary spec (static — hashable frozen dataclass).
       block: window-axis scan block size.
       window_limit: optional int32 [B] — row i only counts window starts
@@ -122,33 +117,146 @@ def score_batch(
     Returns:
       float32 [B, L] accumulated per-language scores.
     """
+    if lut is not None and lut.size == 0:
+        lut = None
     B, S = batch.shape
     L = weights.shape[1]
-    miss_row = weights.shape[0] - 1 if spec.mode == EXACT else 0
+    # Dense strategy has no dedicated miss row; masked windows are zeroed by
+    # the mask multiply inside the block scan, so any in-range row is safe.
+    miss_row = weights.shape[0] - 1 if lut is not None else 0
     total = jnp.zeros((B, L), dtype=jnp.float32)
     for n in spec.gram_lengths:
         W = max(S - n + 1, 1)
         ids = window_ids(batch, n, spec)  # [B, W]
-        if spec.mode == EXACT:
-            rows = _lookup_rows_exact(ids, sorted_ids)
-        else:
-            rows = ids
+        rows = ids if lut is None else lut[ids]
         starts = jnp.arange(W, dtype=jnp.int32)[None, :]
         mask = starts <= (lengths[:, None] - n)  # full windows only
         if window_limit is not None:
             mask = mask & (starts < window_limit[:, None])
         # Partial-window rule for docs shorter than n (Scala sliding parity).
         partial_rows = _partial_window_rows(
-            batch, lengths, n, ids[:, 0], spec, sorted_ids, miss_row
+            batch, lengths, n, ids[:, 0], spec, lut, miss_row
         )
         is_short = lengths < n
         rows = rows.at[:, 0].set(jnp.where(is_short, partial_rows, rows[:, 0]))
         mask = mask.at[:, 0].set(mask[:, 0] | (is_short & (lengths > 0)))
-        if spec.mode == HASHED:
-            # Hashed mode has no zeros row; masked gathers still index row 0,
-            # so the mask multiply inside the block scan is what zeroes them.
-            rows = jnp.where(mask, rows, 0)
         total = total + _block_accumulate(weights, rows, mask, block)
+    return total
+
+
+# --------------------------------------------------- one-hot MXU strategy ----
+
+# Max gram length the one-hot factorization covers: an n-gram histogram is an
+# order-n tensor of byte one-hots; n=2 is a single [256, 256] outer product
+# (one MXU matmul), n=3 would need a [B, 256, 65536] intermediate.
+ONEHOT_MAX_N = 2
+
+
+def onehot_supported(spec: VocabSpec, num_rows: int) -> bool:
+    """True when :func:`score_batch_onehot` applies: exact vocab, grams ⊆
+    {1, 2}, dense weight table over the full id space."""
+    return (
+        spec.mode == EXACT
+        and max(spec.gram_lengths) <= ONEHOT_MAX_N
+        and num_rows == spec.id_space_size
+    )
+
+
+@partial(jax.jit, static_argnames=("spec", "block"))
+def score_batch_onehot(
+    batch: jnp.ndarray,
+    lengths: jnp.ndarray,
+    weights: jnp.ndarray,
+    *,
+    spec: VocabSpec,
+    block: int = 512,
+    window_limit: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Gather-free scoring for exact vocabularies with gram lengths ⊆ {1, 2}.
+
+    Builds the per-document unigram histogram ``[B, 256]`` and bigram
+    histogram ``[B, 256, 256]`` from byte one-hots — the bigram histogram of
+    a window block is ``einsum('bwi,bwj->bij', onehot(byte0)·mask,
+    onehot(byte1))``, a batched MXU matmul — then multiplies by the dense
+    weight table: ``scores = hist1 @ W[:256] + hist2 @ W[256:]``. One-hot
+    entries are exactly 0/1 in bf16 and counts accumulate in f32, so the
+    histograms are exact.
+
+    ``weights`` must be the dense [id_space, L] table (length-1 rows first,
+    then length-2 rows — the ``VocabSpec.offsets`` layout).
+    """
+    assert spec.mode == EXACT and max(spec.gram_lengths) <= ONEHOT_MAX_N
+    B, S = batch.shape
+    max_n = max(spec.gram_lengths)
+    if S < max_n:  # batch narrower than the largest window: zero-extend
+        batch = jnp.pad(batch, ((0, 0), (0, max_n - S)))
+        S = max_n
+    L = weights.shape[1]
+    iota = jnp.arange(256, dtype=jnp.int32)
+    w1 = weights[:256]
+
+    def masked_counts(vals: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+        """Σ_w onehot(vals[b, w]) · mask[b, w] → [B, 256] (f32)."""
+        oh = (vals[..., None] == iota) & mask[..., None]
+        return oh.astype(jnp.float32).sum(axis=1)
+
+    total = jnp.zeros((B, L), dtype=jnp.float32)
+    for n in spec.gram_lengths:
+        W = max(S - n + 1, 1)
+        starts = jnp.arange(W, dtype=jnp.int32)[None, :]
+        mask = starts <= (lengths[:, None] - n)
+        if window_limit is not None:
+            mask = mask & (starts < window_limit[:, None])
+        pad = (-W) % block
+        b_pad = jnp.pad(batch[:, : W + n - 1], ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+        nblk = (W + pad) // block
+
+        if n == 1:
+            vals = b_pad.astype(jnp.int32).reshape(B, nblk, block).transpose(1, 0, 2)
+            m = mask.reshape(B, nblk, block).transpose(1, 0, 2)
+
+            def body1(acc, blk):
+                v, mm = blk
+                return acc + masked_counts(v, mm), None
+
+            hist1, _ = jax.lax.scan(
+                body1, jnp.zeros((B, 256), jnp.float32), (vals, m)
+            )
+            total = total + hist1 @ w1.astype(jnp.float32)
+        else:
+            b0 = b_pad[:, : W + pad] if pad else b_pad[:, :W]
+            b1 = jnp.pad(batch[:, 1 : W + 1], ((0, 0), (0, (-W) % block)))
+            b0 = b0.astype(jnp.int32).reshape(B, nblk, block).transpose(1, 0, 2)
+            b1 = b1.astype(jnp.int32).reshape(B, nblk, block).transpose(1, 0, 2)
+            m = mask.reshape(B, nblk, block).transpose(1, 0, 2)
+
+            def body2(acc, blk):
+                v0, v1, mm = blk
+                oh0 = ((v0[..., None] == iota) & mm[..., None]).astype(jnp.bfloat16)
+                oh1 = (v1[..., None] == iota).astype(jnp.bfloat16)
+                h = jnp.einsum(
+                    "bwi,bwj->bij", oh0, oh1,
+                    preferred_element_type=jnp.float32,
+                )
+                return acc + h, None
+
+            hist2, _ = jax.lax.scan(
+                body2, jnp.zeros((B, 256, 256), jnp.float32), (b0, b1, m)
+            )
+            w2 = weights[spec.offsets[2] : spec.offsets[2] + 65536]
+            total = total + hist2.reshape(B, 65536) @ w2.astype(jnp.float32)
+
+        # Partial-window rule (Scala sliding parity): a doc shorter than n
+        # contributes its whole-byte prefix once, in the prefix's own length
+        # class — here only len==1 docs under n==2 (len==0 emits nothing).
+        if n == 2:
+            is_short = lengths == 1
+            short_oh = (
+                (batch[:, 0].astype(jnp.int32)[:, None] == iota)
+                & is_short[:, None]
+            )
+            total = total + short_oh.astype(jnp.float32) @ w1.astype(jnp.float32)
     return total
 
 
@@ -166,7 +274,13 @@ def score_batch_numpy(
     sorted_ids: np.ndarray | None,
     spec: VocabSpec,
 ) -> np.ndarray:
-    """Vectorized host scorer with identical semantics (no padding needed)."""
+    """Vectorized host scorer with identical semantics (no padding needed).
+
+    ``weights``/``sorted_ids`` are the *profile* arrays (compact [G, L] +
+    ascending ids for exact mode; dense [V, L] + None for hashed) — the host
+    mirror keeps the binary-search membership formulation since numpy's
+    searchsorted is fast on CPU.
+    """
     from .vocab import short_doc_ids_numpy, window_ids_numpy
 
     L = weights.shape[1]
@@ -183,7 +297,7 @@ def score_batch_numpy(
             ids_all.append(np.asarray(short, dtype=np.int64))
         if ids_all:
             ids = np.concatenate(ids_all)
-            if spec.mode == EXACT:
+            if sorted_ids is not None:
                 if len(sorted_ids) == 0:
                     rows = np.full(len(ids), weights.shape[0] - 1)
                 else:

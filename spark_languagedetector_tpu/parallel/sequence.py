@@ -67,14 +67,14 @@ def _long_doc_score_jit(b, l, lim, w, ids, *, spec, mesh_static):
 def make_long_doc_scorer(mesh: Mesh, spec: VocabSpec, chunk_size: int = 8192):
     """Compile-once scorer for arbitrarily long single documents.
 
-    Returns ``fn(doc: bytes, weights, sorted_ids|None) -> np.ndarray [L]``.
+    Returns ``fn(doc: bytes, weights, lut|None) -> np.ndarray [L]``.
     The jit cache is keyed on (spec, mesh) — repeated calls with different
     documents reuse the compiled executables per padded grid shape.
     """
     n_data = mesh.shape[DATA_AXIS]
     b_shard, rep = batch_sharding(mesh), replicated(mesh)
 
-    def score(doc: bytes, weights, sorted_ids=None) -> np.ndarray:
+    def score(doc: bytes, weights, lut=None) -> np.ndarray:
         batch, lengths, limits = chunk_grid(doc, n_data, chunk_size, spec.gram_lengths)
         args = [
             jax.device_put(batch, b_shard),
@@ -82,7 +82,7 @@ def make_long_doc_scorer(mesh: Mesh, spec: VocabSpec, chunk_size: int = 8192):
             jax.device_put(limits, b_shard),
             jax.device_put(weights, rep),
         ]
-        ids = None if sorted_ids is None else jax.device_put(sorted_ids, rep)
+        ids = None if lut is None else jax.device_put(lut, rep)
         return np.asarray(
             _long_doc_score_jit(*args, ids, spec=spec, mesh_static=mesh)
         )
@@ -93,7 +93,7 @@ def make_long_doc_scorer(mesh: Mesh, spec: VocabSpec, chunk_size: int = 8192):
 def score_long_document(
     doc: bytes,
     weights,
-    sorted_ids,
+    lut,
     spec: VocabSpec,
     mesh: Mesh,
     chunk_size: int = 8192,
@@ -101,7 +101,7 @@ def score_long_document(
     """Exact [L] score of one document of any length, computed across the
     mesh's data axis. Thin wrapper over :func:`make_long_doc_scorer`; the
     underlying computation is compiled once per (spec, mesh, grid shape)."""
-    return make_long_doc_scorer(mesh, spec, chunk_size)(doc, weights, sorted_ids)
+    return make_long_doc_scorer(mesh, spec, chunk_size)(doc, weights, lut)
 
 
 def ring_score_chunks(
@@ -109,7 +109,7 @@ def ring_score_chunks(
     lengths: jnp.ndarray,
     limits: jnp.ndarray,
     weights: jnp.ndarray,
-    sorted_ids: jnp.ndarray | None,
+    lut: jnp.ndarray | None,
     spec: VocabSpec,
     mesh: Mesh,
 ) -> jnp.ndarray:
@@ -140,7 +140,7 @@ def ring_score_chunks(
         acc, _ = jax.lax.fori_loop(0, n_data - 1, hop, (local, local))
         return acc[None, :]
 
-    ids_arr = sorted_ids if sorted_ids is not None else jnp.zeros(0, jnp.int32)
+    ids_arr = lut if lut is not None else jnp.zeros(0, jnp.int32)
     fn = jax.shard_map(
         shard_fn,
         mesh=mesh,

@@ -42,15 +42,17 @@ def make_sharded_scorer(
 ):
     """jit-compiled scorer with mesh shardings baked in.
 
-    Returns ``fn(batch [B,S] u8, lengths [B] i32, weights, sorted_ids|None)
-    -> scores [B,L] f32`` with B divisible by the data-axis size.
+    Returns ``fn(batch [B,S] u8, lengths [B] i32, weights, lut|None)
+    -> scores [B,L] f32`` with B divisible by the data-axis size. ``weights``
+    is either the dense [V, L] table (lut None — shardable over ``vocab``)
+    or the compact [G+1, L] table with its int32 id→row ``lut``.
     """
     w_sharding = vocab_sharding(mesh) if shard_vocab else replicated(mesh)
     in_shardings = (
         batch_sharding(mesh),  # batch
         batch_sharding(mesh),  # lengths
         w_sharding,  # weights
-        replicated(mesh),  # sorted_ids (kept replicated: binary search is cheap)
+        replicated(mesh),  # lut (small int32 table; replicate over ICI)
     )
 
     @partial(
@@ -59,16 +61,17 @@ def make_sharded_scorer(
         out_shardings=batch_sharding(mesh),
         static_argnames=(),
     )
-    def scorer(batch, lengths, weights, sorted_ids):
+    def scorer(batch, lengths, weights, lut):
         return score_batch(
-            batch, lengths, weights, sorted_ids, spec=spec, block=block
+            batch, lengths, weights, lut, spec=spec, block=block
         )
 
-    def scorer_no_ids(batch, lengths, weights):
-        # hashed mode: no sorted-id vector
-        return scorer(batch, lengths, weights, jnp.zeros(0, jnp.int32))
+    def wrapper(batch, lengths, weights, lut=None):
+        if lut is None:
+            lut = jnp.zeros(0, jnp.int32)  # sentinel: dense direct indexing
+        return scorer(batch, lengths, weights, lut)
 
-    return scorer if spec.mode == "exact" else scorer_no_ids
+    return wrapper
 
 
 def make_sharded_fit_step(

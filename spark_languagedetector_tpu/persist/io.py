@@ -98,12 +98,12 @@ def save_model(
             }
         )
     else:
-        nonzero = np.flatnonzero(np.abs(profile.weights).sum(axis=1))
+        compact = profile.compacted()
         prob_table = pa.table(
             {
-                "bucket": pa.array(nonzero.tolist(), type=pa.int64()),
+                "bucket": pa.array(compact.ids.tolist(), type=pa.int64()),
                 "probabilities": pa.array(
-                    [profile.weights[i].tolist() for i in nonzero],
+                    [row.tolist() for row in compact.weights],
                     type=pa.list_(pa.float64()),
                 ),
             }
@@ -152,8 +152,9 @@ def load_model(path: str | Path) -> tuple[GramProfile, str, dict]:
     if mode == EXACT:
         grams = prob["gram"].to_pylist()
         pairs = sorted(
-            (spec.gram_to_id(bytes(g)), np.asarray(w, dtype=np.float64))
-            for g, w in zip(grams, weights_rows)
+            ((spec.gram_to_id(bytes(g)), np.asarray(w, dtype=np.float64))
+             for g, w in zip(grams, weights_rows)),
+            key=lambda p: p[0],
         )
         ids = np.asarray([p[0] for p in pairs], dtype=np.int64)
         weights = (
@@ -162,10 +163,17 @@ def load_model(path: str | Path) -> tuple[GramProfile, str, dict]:
             else np.zeros((0, L), dtype=np.float64)
         )
     else:
-        ids = np.zeros(0, dtype=np.int64)
-        weights = np.zeros((spec.id_space_size, L), dtype=np.float64)
-        for bucket, row in zip(prob["bucket"].to_pylist(), weights_rows):
-            weights[bucket] = row
+        pairs = sorted(
+            ((int(b), np.asarray(w, dtype=np.float64))
+             for b, w in zip(prob["bucket"].to_pylist(), weights_rows)),
+            key=lambda p: p[0],
+        )
+        ids = np.asarray([p[0] for p in pairs], dtype=np.int64)
+        weights = (
+            np.stack([p[1] for p in pairs])
+            if pairs
+            else np.zeros((0, L), dtype=np.float64)
+        )
 
     profile = GramProfile(spec=spec, languages=languages, ids=ids, weights=weights)
     return profile, meta["uid"], meta.get("paramMap", {})
@@ -192,12 +200,12 @@ def save_gram_dump(path: str | Path, profile: GramProfile) -> None:
             }
         )
     else:
-        nonzero = np.flatnonzero(np.abs(profile.weights).sum(axis=1))
+        compact = profile.compacted()
         table = pa.table(
             {
-                "bucket": pa.array(nonzero.tolist(), type=pa.int64()),
+                "bucket": pa.array(compact.ids.tolist(), type=pa.int64()),
                 "probabilities": pa.array(
-                    [profile.weights[i].tolist() for i in nonzero],
+                    [row.tolist() for row in compact.weights],
                     type=pa.list_(pa.float64()),
                 ),
             }

@@ -20,10 +20,10 @@ GRAM_MAP = {
 
 def _runner(max_chunk=64, batch_size=4):
     profile = GramProfile.from_gram_map(GRAM_MAP, LANGS, (2, 3))
-    weights, sorted_ids = profile.device_arrays()
+    weights, lut = profile.device_arrays()
     return profile, BatchRunner(
         weights=weights,
-        sorted_ids=sorted_ids,
+        lut=lut,
         spec=profile.spec,
         batch_size=batch_size,
         length_buckets=(16, max_chunk),
@@ -63,8 +63,8 @@ def test_chunking_matches_numpy_scorer_on_many_docs():
     ]
     docs = texts_to_bytes(texts)
     scores = runner.score(docs)
-    weights = np.concatenate([profile.weights, np.zeros((1, 2))])
-    host = score_batch_numpy(docs, weights, profile.ids, profile.spec)
+    weights, sorted_ids = profile.host_arrays()
+    host = score_batch_numpy(docs, weights, sorted_ids, profile.spec)
     np.testing.assert_allclose(scores, host, rtol=1e-5, atol=1e-6)
 
 

@@ -93,12 +93,8 @@ def test_numpy_scorer_matches_device():
     profile = GramProfile.from_gram_map(GRAM_MAP, LANGS, (3,))
     weights, sorted_ids = profile.device_arrays()
     docs = texts_to_bytes(TEXTS + ["ab", ""])
-    host = S.score_batch_numpy(
-        docs,
-        np.concatenate([profile.weights, np.zeros((1, 2))]),
-        profile.ids,
-        profile.spec,
-    )
+    host_weights, host_ids = profile.host_arrays()
+    host = S.score_batch_numpy(docs, host_weights, host_ids, profile.spec)
     batch, lengths = pad_batch(docs, pad_to=max(len(d) for d in docs))
     dev = np.asarray(
         S.score_batch(batch, lengths, weights, sorted_ids, spec=profile.spec)
@@ -131,3 +127,100 @@ def test_argmax_first_max_wins():
 
     scores = jnp.asarray([[1.0, 1.0, 0.5], [0.0, 2.0, 2.0]])
     assert S.argmax_language(scores).tolist() == [0, 1]
+
+
+# --- device strategies: dense gather vs LUT gather vs one-hot MXU ------------
+
+
+def test_lut_strategy_matches_dense():
+    """Forcing the compact-LUT path (tiny dense budget) must be bit-identical
+    to dense direct indexing."""
+    profile = GramProfile.from_gram_map(GRAM_MAP, LANGS, (3,))
+    docs = texts_to_bytes(TEXTS + ["ab", "", "zzzz"])
+    batch, lengths = pad_batch(docs, pad_to=max(len(d) for d in docs))
+
+    w_dense, lut_none = profile.device_arrays(dense_budget_bytes=1 << 40)
+    assert lut_none is None
+    w_compact, lut = profile.device_arrays(dense_budget_bytes=0)
+    assert lut is not None and lut.shape[0] == profile.spec.id_space_size
+
+    dense = np.asarray(
+        S.score_batch(batch, lengths, w_dense, None, spec=profile.spec)
+    )
+    compact = np.asarray(
+        S.score_batch(batch, lengths, w_compact, lut, spec=profile.spec)
+    )
+    np.testing.assert_array_equal(dense, compact)
+
+
+def test_onehot_strategy_matches_oracle():
+    grams = {
+        b"a": [0.3, 0.1],
+        b"b": [0.05, 0.4],
+        b"th": [0.0, 0.9],
+        b"ch": [0.8, 0.0],
+        b"ab": [1.1, 0.2],
+    }
+    profile = GramProfile.from_gram_map(grams, LANGS, (1, 2))
+    texts = TEXTS + ["a", "", "th", "abab", "x"]
+    docs = texts_to_bytes(texts)
+    batch, lengths = pad_batch(docs, pad_to=max(len(d) for d in docs))
+    weights, lut = profile.device_arrays()
+    assert lut is None and S.onehot_supported(profile.spec, weights.shape[0])
+    scores = np.asarray(
+        S.score_batch_onehot(batch, lengths, weights, spec=profile.spec, block=32)
+    )
+    for row, text in zip(scores, texts):
+        expected = scores_oracle(text, grams, 2, [1, 2])
+        np.testing.assert_allclose(row, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_onehot_matches_gather_bigram_only():
+    """gramLengths=(2,): partial windows of len-1 docs land in the unigram
+    id space — both strategies must agree exactly."""
+    grams = {b"ab": [1.0, 0.0], b"a": [0.0, 0.7], b"zz": [0.0, 2.0]}
+    profile = GramProfile.from_gram_map(grams, LANGS, (2,))
+    docs = texts_to_bytes(["abab", "a", "", "zzz", "ba"])
+    batch, lengths = pad_batch(docs, pad_to=8)
+    weights, lut = profile.device_arrays()
+    gather = np.asarray(
+        S.score_batch(batch, lengths, weights, lut, spec=profile.spec, block=16)
+    )
+    onehot = np.asarray(
+        S.score_batch_onehot(batch, lengths, weights, spec=profile.spec, block=16)
+    )
+    np.testing.assert_allclose(onehot, gather, rtol=1e-6, atol=1e-7)
+
+
+def test_onehot_respects_window_limit():
+    import jax.numpy as jnp
+
+    grams = {b"ab": [1.0, 0.0]}
+    profile = GramProfile.from_gram_map(grams, LANGS, (2,))
+    docs = texts_to_bytes(["ababab"])  # windows ab,ba,ab,ba,ab
+    batch, lengths = pad_batch(docs, pad_to=8)
+    weights, _ = profile.device_arrays()
+    limited = np.asarray(
+        S.score_batch_onehot(
+            batch, lengths, weights, spec=profile.spec,
+            window_limit=jnp.asarray([3], jnp.int32),
+        )
+    )
+    # starts 0..2 only: windows ab, ba, ab → 2 hits
+    np.testing.assert_allclose(limited[0], [2.0, 0.0])
+
+
+def test_runner_auto_selects_onehot():
+    from spark_languagedetector_tpu.api.runner import BatchRunner
+
+    profile = GramProfile.from_gram_map({b"ab": [1.0, 0.0]}, LANGS, (1, 2))
+    weights, lut = profile.device_arrays()
+    runner = BatchRunner(weights=weights, lut=lut, spec=profile.spec)
+    assert runner.strategy == "onehot"
+    scores = runner.score(texts_to_bytes(["abab", ""]))
+    np.testing.assert_allclose(scores[0], [2.0, 0.0])
+
+    profile3 = GramProfile.from_gram_map(GRAM_MAP, LANGS, (3,))
+    w3, lut3 = profile3.device_arrays(dense_budget_bytes=0)
+    runner3 = BatchRunner(weights=w3, lut=lut3, spec=profile3.spec)
+    assert runner3.strategy == "gather"

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import score as score_ops
+from ..ops import score_pallas
 from ..ops.encoding import (
     DEFAULT_LENGTH_BUCKETS,
     bucket_length,
@@ -41,6 +42,12 @@ from ..utils.metrics import Metrics
 _log = get_logger("api.runner")
 
 DEFAULT_BATCH_SIZE = 256
+# The fused pallas kernel keeps per-document state in VMEM scratch (no
+# O(B·vocab) HBM buffers), so its sweet spot is much larger micro-batches —
+# fewer dispatches amortize the per-call host/tunnel overhead. 4096×2048
+# bytes ≈ 8MB per transfer, under the tunneled-TPU h2d bandwidth cliff
+# (measured ~770MB/s ≤8MB vs ~210MB/s at 32MB).
+DEFAULT_PALLAS_BATCH_SIZE = 4096
 
 
 def resolve_device(backend: str):
@@ -73,11 +80,11 @@ class BatchRunner:
     weights: jnp.ndarray
     lut: jnp.ndarray | None
     spec: VocabSpec
-    batch_size: int = DEFAULT_BATCH_SIZE
+    batch_size: int | None = None  # None ⇒ auto per strategy
     length_buckets: tuple[int, ...] = DEFAULT_LENGTH_BUCKETS
     block: int = score_ops.DEFAULT_BLOCK
     device: object | None = None  # jax device; None ⇒ process default
-    strategy: str = "auto"  # 'auto' | 'gather' | 'onehot'
+    strategy: str = "auto"  # 'auto' | 'gather' | 'onehot' | 'pallas'
     metrics: Metrics = field(default_factory=Metrics)
 
     def __post_init__(self):
@@ -85,24 +92,56 @@ class BatchRunner:
             self.weights = jax.device_put(self.weights, self.device)
             if self.lut is not None:
                 self.lut = jax.device_put(self.lut, self.device)
-        if self.strategy not in ("auto", "gather", "onehot"):
+        if self.strategy not in ("auto", "gather", "onehot", "pallas"):
             raise ValueError(
                 f"unknown strategy {self.strategy!r}; "
-                "expected 'auto', 'gather', or 'onehot'"
+                "expected 'auto', 'gather', 'onehot', or 'pallas'"
             )
+        pallas_ok = self.lut is None and score_pallas.pallas_supported(
+            self.spec, self.weights.shape[0], self.weights.shape[1]
+        )
         if self.strategy == "auto":
-            # One-hot MXU scoring (no gathers) when the vocab qualifies:
-            # exact grams ⊆ {1,2} over the dense table.
-            eligible = self.lut is None and score_ops.onehot_supported(
+            # Fused pallas kernel on real accelerators when the vocab
+            # qualifies (exact grams ⊆ {1,2}, dense table, few languages);
+            # one-hot MXU via XLA otherwise-qualifying on CPU (pallas
+            # interpret mode is far too slow outside tests); gather fallback.
+            target = self.device or jax.devices()[0]
+            if pallas_ok and target.platform == "tpu":
+                self.strategy = "pallas"
+            elif self.lut is None and score_ops.onehot_supported(
                 self.spec, self.weights.shape[0]
-            )
-            self.strategy = "onehot" if eligible else "gather"
+            ):
+                self.strategy = "onehot"
+            else:
+                self.strategy = "gather"
         if self.strategy == "onehot" and not score_ops.onehot_supported(
             self.spec, self.weights.shape[0]
         ):
             raise ValueError(
                 "strategy='onehot' needs an exact vocab with gram lengths <= "
                 f"{score_ops.ONEHOT_MAX_N} and the dense weight table"
+            )
+        if self.strategy == "pallas":
+            if not pallas_ok:
+                raise ValueError(
+                    "strategy='pallas' needs an exact vocab with gram lengths "
+                    "<= 2, the dense weight table, and at most "
+                    f"{score_pallas.MAX_PALLAS_LANGS} languages"
+                )
+            target = self.device or jax.devices()[0]
+            # Mosaic only lowers on TPU; anywhere else (CPU tests, GPU) the
+            # explicit pallas strategy runs in interpret mode.
+            self._pallas_interpret = target.platform != "tpu"
+            w1, w2 = score_pallas.weight_views(self.weights, self.spec)
+            if self.device is not None:
+                w1 = jax.device_put(w1, self.device)
+                w2 = jax.device_put(w2, self.device)
+            self._pallas_w1, self._pallas_w2 = w1, w2
+        if self.batch_size is None:
+            self.batch_size = (
+                DEFAULT_PALLAS_BATCH_SIZE
+                if self.strategy == "pallas"
+                else DEFAULT_BATCH_SIZE
             )
         # Trigger the one-time native-library build here, not inside the
         # first score() call's timed hot loop.
@@ -170,14 +209,26 @@ class BatchRunner:
                     window_limit = None
                 else:
                     window_limit = np.asarray(batch_limits, dtype=np.int32)
-                if self.device is not None:
-                    batch = jax.device_put(batch, self.device)
-                    lengths = jax.device_put(lengths, self.device)
-                    if window_limit is not None:
-                        window_limit = jax.device_put(window_limit, self.device)
-                elif window_limit is not None:
-                    window_limit = jnp.asarray(window_limit)
-                if self.strategy == "onehot":
+                # Explicit async device_put: passing numpy operands straight
+                # into the jitted call makes the h2d copy synchronous on the
+                # dispatch path (~8.7ms/batch over a tunneled TPU, measured),
+                # while device_put returns immediately and overlaps the copy
+                # with packing the next batch (~0.2ms dispatch).
+                batch = jax.device_put(batch, self.device)
+                lengths = jax.device_put(lengths, self.device)
+                if window_limit is not None:
+                    window_limit = jax.device_put(window_limit, self.device)
+                if self.strategy == "pallas":
+                    scores = score_pallas.score_batch_pallas(
+                        batch,
+                        lengths,
+                        self._pallas_w1,
+                        self._pallas_w2,
+                        window_limit,
+                        spec=self.spec,
+                        interpret=self._pallas_interpret,
+                    )
+                elif self.strategy == "onehot":
                     scores = score_ops.score_batch_onehot(
                         batch,
                         lengths,
@@ -196,17 +247,27 @@ class BatchRunner:
                         block=self.block,
                         window_limit=window_limit,
                     )
-                # Async dispatch: keep packing while the device works — and
-                # start the device→host copy as soon as the compute finishes
-                # (a cold fetch over a tunneled device costs ~100ms; the
-                # async prefetch overlaps it with the remaining batches).
-                scores.copy_to_host_async()
+                # Async dispatch: keep packing while the device works.
                 pending.append((sel, scores))
                 self.metrics.incr("chunks_scored", len(sel))
 
+            # ONE device→host fetch for the whole call: per-batch fetches
+            # each pay the device-sync latency (measured ~8ms/batch over a
+            # tunneled TPU, dwarfing the ~1ms compute), so the per-batch
+            # results are concatenated on device and pulled in a single
+            # transfer instead.
+            if len(pending) > 1:
+                all_scores = jnp.concatenate([s for _, s in pending], axis=0)
+            else:
+                all_scores = pending[0][1]
+            all_host = np.asarray(all_scores)
             doc_idx_arr = np.asarray(doc_idx, dtype=np.int64)
-            for sel, scores in pending:
-                np.add.at(out, doc_idx_arr[sel], np.asarray(scores))
+            offset = 0
+            for sel, _ in pending:
+                np.add.at(
+                    out, doc_idx_arr[sel], all_host[offset : offset + len(sel)]
+                )
+                offset += len(sel)
 
         self.metrics.incr("docs_scored", N)
         log_event(

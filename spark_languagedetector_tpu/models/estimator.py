@@ -212,7 +212,11 @@ class LanguageDetectorModel(HasInputCol, HasOutputCol):
         "(the BASELINE north star's .setBackend switch)",
         lambda v: v in BACKENDS,
     )
-    batch_size = Param("batchSize", "micro-batch rows per device dispatch", _positive_int)
+    batch_size = Param(
+        "batchSize",
+        "micro-batch rows per device dispatch; None ⇒ auto per strategy",
+        lambda v: v is None or _positive_int(v),
+    )
 
     def __init__(self, profile: GramProfile, uid: str | None = None):
         super().__init__(uid, uid_prefix="LanguageDetectorModel")
@@ -222,7 +226,7 @@ class LanguageDetectorModel(HasInputCol, HasOutputCol):
             outputCol="lang",
             predictEncoding=UTF8,
             backend=BACKEND_AUTO,
-            batchSize=256,
+            batchSize=None,
         )
         self._runner: BatchRunner | None = None
 

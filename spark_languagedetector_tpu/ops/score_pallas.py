@@ -1,0 +1,220 @@
+"""Fused one-hot scoring as a single Pallas TPU kernel.
+
+The XLA one-hot strategy (:func:`ops.score.score_batch_onehot`) materializes
+per-block one-hots and a per-document ``[B, 256, 256]`` histogram accumulator
+in HBM — ~600MB of HBM traffic per [256, 2048] batch for ~50 GFLOP of MXU
+work, and O(B·65536) memory that caps the micro-batch size. This kernel fuses
+the whole pipeline in VMEM:
+
+    bytes block → one-hot (VPU, registers) → [256, BLK]ᵀ·[256, BLK] bigram
+    histogram accumulate (MXU, VMEM scratch) → ⟨hist, W_l⟩ contraction (VPU)
+
+Per document the only HBM traffic is the byte row in and L floats out, and
+per-document state is a constant 256KB VMEM scratch — so micro-batches can be
+thousands of documents, amortizing the per-dispatch host/tunnel overhead that
+dominates the XLA path (measured: ~0.4ms vs ~1.25ms per [256, 2048] batch,
+and 8×+ fewer dispatches end-to-end).
+
+Replaces the reference's per-window JVM hash-map + ``BLAS.axpy`` hot loop
+(``/root/reference/src/main/.../LanguageDetectorModel.scala:139-152``) for
+exact vocabularies with gram lengths ⊆ {1, 2}; other configs use the gather
+strategies in :mod:`ops.score`.
+
+Mosaic constraints shaping the code (all found empirically):
+  * every intermediate is kept 2-D (rank-1 values crash the lowering);
+  * lane-dimension dynamic slices must be 128-aligned, so the "next byte"
+    plane is a pre-shifted copy of the batch prepared by XLA outside the
+    kernel rather than an off-by-one slice inside it;
+  * one-hots are built lane-major ``[256, BLK]`` (windows on lanes) so no
+    transposes are needed: the bigram histogram is an NT contraction over
+    the shared lane axis.
+
+Semantics parity with :func:`ops.score.score_batch` (SURVEY.md §2.9): unknown
+grams contribute zero, all-miss documents argmax to index 0, a document
+shorter than a configured gram length contributes its whole-byte prefix once
+per such length (Scala ``sliding`` partial-window rule — applied in the XLA
+wrapper, not the kernel, since it touches only ``lengths < 2`` rows).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .vocab import EXACT, VocabSpec
+
+# Documents per grid step: the sublane tile height of the batch block.
+DB = 8
+
+# Window-axis block (lane dimension of the one-hots). 512 divides every
+# default length bucket except 128 (handled by shrinking to the padded S).
+DEFAULT_BLOCK = 512
+
+# VMEM budget cap: the bigram weight view is L * 256KB resident per dispatch.
+MAX_PALLAS_LANGS = 16
+
+
+def pallas_supported(spec: VocabSpec, num_rows: int, num_langs: int) -> bool:
+    """True when this kernel applies: exact vocab, gram lengths ⊆ {1, 2},
+    dense weight table over the full id space, small language count."""
+    return (
+        spec.mode == EXACT
+        and max(spec.gram_lengths) <= 2
+        and num_rows == spec.id_space_size
+        and num_langs <= MAX_PALLAS_LANGS
+    )
+
+
+def weight_views(
+    weights: np.ndarray | jnp.ndarray, spec: VocabSpec
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Dense [V, L] table → kernel views: w1 [256, L], w2 [L, 256, 256].
+
+    Call once per profile (the reshape/transpose is a real relayout — don't
+    re-do it per batch). For gram_lengths == (1,) the bigram view is zeros.
+    """
+    w = jnp.asarray(weights, dtype=jnp.float32)
+    L = w.shape[1]
+    w1 = w[:256]
+    if 2 in spec.gram_lengths:
+        off = spec.offsets[2]
+        w2 = w[off : off + 65536].reshape(256, 256, L).transpose(2, 0, 1)
+    else:
+        w2 = jnp.zeros((L, 256, 256), dtype=jnp.float32)
+    return w1, w2
+
+
+def _build_kernel(S: int, L: int, blk: int, has1: bool, has2: bool):
+    n_steps = S // blk
+
+    def kernel(b0_ref, b1_ref, len_ref, lim_ref, w1_ref, w2_ref, o_ref,
+               acc2_ref, acc1_ref):
+        base = pl.program_id(0) * DB
+        for d in range(DB):
+            dlen = len_ref[base + d]
+            dlim = lim_ref[base + d]
+            if has2:
+                acc2_ref[:, :] = jnp.zeros((256, 256), jnp.float32)
+            if has1:
+                acc1_ref[:, :] = jnp.zeros((256, 128), jnp.float32)
+            for k in range(n_steps):
+                off = k * blk
+                vals = b0_ref[pl.dslice(d, 1), pl.dslice(off, blk)]  # [1, blk]
+                iota = jax.lax.broadcasted_iota(jnp.int32, (256, blk), 0)
+                starts = jax.lax.broadcasted_iota(jnp.int32, (1, blk), 1) + off
+                lim_ok = starts < dlim
+                if has2:
+                    nxt = b1_ref[pl.dslice(d, 1), pl.dslice(off, blk)]
+                    mask2 = (starts <= dlen - 2) & lim_ok
+                    oh0 = jnp.where(
+                        (vals == iota) & mask2, 1.0, 0.0
+                    ).astype(jnp.bfloat16)
+                    oh1 = jnp.where(nxt == iota, 1.0, 0.0).astype(jnp.bfloat16)
+                    acc2_ref[:, :] += jax.lax.dot_general(
+                        oh0, oh1, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                if has1:
+                    mask1 = (starts <= dlen - 1) & lim_ok
+                    ohu = jnp.where((vals == iota) & mask1, 1.0, 0.0)
+                    acc1_ref[:, 0:1] += ohu.sum(axis=1, keepdims=True)
+            for l in range(L):
+                s = jnp.zeros((1, 1), jnp.float32)
+                if has2:
+                    t2 = acc2_ref[:, :] * w2_ref[l]
+                    s = s + t2.sum(axis=0, keepdims=True).sum(
+                        axis=1, keepdims=True
+                    )
+                if has1:
+                    t1 = acc1_ref[:, 0:1] * w1_ref[:, pl.dslice(l, 1)]
+                    s = s + t1.sum(axis=0, keepdims=True)
+                o_ref[pl.dslice(d, 1), pl.dslice(l, 1)] = s
+
+    return kernel
+
+
+@partial(jax.jit, static_argnames=("spec", "block", "interpret"))
+def score_batch_pallas(
+    batch: jnp.ndarray,
+    lengths: jnp.ndarray,
+    w1: jnp.ndarray,
+    w2: jnp.ndarray,
+    window_limit: jnp.ndarray | None = None,
+    *,
+    spec: VocabSpec,
+    block: int = DEFAULT_BLOCK,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """float32 [B, L] scores for a padded uint8 batch via the fused kernel.
+
+    Args mirror :func:`ops.score.score_batch` except the weight table arrives
+    pre-shaped by :func:`weight_views`. ``interpret=True`` runs the kernel in
+    Pallas interpret mode (any backend — used by the CPU tests).
+    """
+    assert spec.mode == EXACT and max(spec.gram_lengths) <= 2
+    has1 = 1 in spec.gram_lengths
+    has2 = 2 in spec.gram_lengths
+    B0, S0 = batch.shape
+    L = w1.shape[1]
+
+    # Lane padding: S must be a multiple of the window block.
+    blk = min(block, -(-S0 // 128) * 128)
+    S = -(-S0 // blk) * blk
+    if S != S0:
+        batch = jnp.pad(batch, ((0, 0), (0, S - S0)))
+    # Sublane padding: whole DB-document grid steps (padded rows: length 0).
+    B = -(-B0 // DB) * DB
+    if B != B0:
+        batch = jnp.pad(batch, ((0, B - B0), (0, 0)))
+        lengths = jnp.pad(lengths, (0, B - B0))
+        if window_limit is not None:
+            window_limit = jnp.pad(window_limit, (0, B - B0))
+
+    b0 = batch.astype(jnp.int32)
+    # Pre-shifted "next byte" plane (Mosaic needs 128-aligned lane slices).
+    b1 = jnp.pad(b0[:, 1:], ((0, 0), (0, 1))) if has2 else b0
+    lim = (
+        jnp.full((B,), S, dtype=jnp.int32)
+        if window_limit is None
+        else window_limit.astype(jnp.int32)
+    )
+
+    out = pl.pallas_call(
+        _build_kernel(S, L, blk, has1, has2),
+        grid=(B // DB,),
+        in_specs=[
+            pl.BlockSpec((DB, S), lambda b: (b, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((DB, S), lambda b: (b, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((256, L), lambda b: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (L, 256, 256), lambda b: (0, 0, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec((DB, L), lambda b: (b, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, L), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((256, 256), jnp.float32),
+            pltpu.VMEM((256, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)
+        ),
+        interpret=interpret,
+    )(b0, b1, lengths.astype(jnp.int32), lim, w1, w2)
+
+    if has2:
+        # Partial-window rule: a 1-byte document under gram length 2
+        # contributes its single byte once, in the length-1 id space. Chunking
+        # never produces 1-byte rows, so window_limit cannot apply here.
+        corr = jnp.where(
+            (lengths == 1)[:, None], w1[b0[:, 0]], 0.0
+        )
+        out = out + corr
+    return out[:B0]

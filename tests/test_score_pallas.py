@@ -1,0 +1,133 @@
+"""Fused pallas scorer parity (interpret mode on the CPU test mesh).
+
+The kernel itself targets TPU; interpret mode executes the same program
+semantics on any backend, so these tests pin the kernel's numerics to the
+XLA strategies and the numpy oracle on tiny shapes.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_languagedetector_tpu.ops import score as S
+from spark_languagedetector_tpu.ops import score_pallas as SP
+from spark_languagedetector_tpu.ops.encoding import pad_batch
+from spark_languagedetector_tpu.ops.vocab import EXACT, HASHED, VocabSpec
+
+
+def _random_docs(rng, n_docs, max_len):
+    docs = []
+    for _ in range(n_docs):
+        ln = int(rng.integers(0, max_len))
+        docs.append(bytes(rng.integers(0, 256, ln, dtype=np.uint8)))
+    return docs
+
+
+def _pallas_scores(docs, weights, spec, pad_to, window_limit=None):
+    batch, lengths = pad_batch(docs, pad_to=pad_to)
+    w1, w2 = SP.weight_views(weights, spec)
+    lim = None if window_limit is None else jnp.asarray(window_limit)
+    return np.asarray(
+        SP.score_batch_pallas(
+            jnp.asarray(batch),
+            jnp.asarray(lengths),
+            w1,
+            w2,
+            lim,
+            spec=spec,
+            block=128,
+            interpret=True,
+        )
+    )
+
+
+@pytest.mark.parametrize("gram_lengths", [(1,), (2,), (1, 2)])
+def test_matches_numpy_oracle(gram_lengths):
+    spec = VocabSpec(EXACT, gram_lengths)
+    rng = np.random.default_rng(7)
+    weights = rng.normal(size=(spec.id_space_size, 3)).astype(np.float32)
+    # Lengths 0 and 1 exercise the empty-doc and partial-window rules.
+    docs = [b"", b"a", b"ab", b"hello world"] + _random_docs(rng, 12, 300)
+    got = _pallas_scores(docs, weights, spec, pad_to=384)
+    want = S.score_batch_numpy(docs, weights, None, spec)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
+
+
+def test_matches_xla_onehot_strategy():
+    spec = VocabSpec(EXACT, (2,))
+    rng = np.random.default_rng(11)
+    weights = rng.normal(size=(spec.id_space_size, 5)).astype(np.float32)
+    docs = _random_docs(rng, 16, 250) + [b"", b"x"]
+    batch, lengths = pad_batch(docs, pad_to=256)
+    xla = np.asarray(
+        S.score_batch_onehot(
+            jnp.asarray(batch), jnp.asarray(lengths), jnp.asarray(weights),
+            spec=spec, block=128,
+        )
+    )
+    got = _pallas_scores(docs, weights, spec, pad_to=256)
+    np.testing.assert_allclose(got, xla, rtol=1e-4, atol=1e-3)
+
+
+def test_window_limit_matches_gather_strategy():
+    """Chunked long-doc scoring: only owned window starts count."""
+    spec = VocabSpec(EXACT, (1, 2))
+    rng = np.random.default_rng(13)
+    weights = rng.normal(size=(spec.id_space_size, 3)).astype(np.float32)
+    docs = _random_docs(rng, 8, 250)
+    docs = [d if len(d) >= 2 else b"ab" for d in docs]
+    batch, lengths = pad_batch(docs, pad_to=256)
+    limit = np.asarray([100, 256, 3, 17, 250, 1, 56, 200], dtype=np.int32)
+    gather = np.asarray(
+        S.score_batch(
+            jnp.asarray(batch), jnp.asarray(lengths), jnp.asarray(weights),
+            None, spec=spec, block=128, window_limit=jnp.asarray(limit),
+        )
+    )
+    got = _pallas_scores(docs, weights, spec, pad_to=256, window_limit=limit)
+    np.testing.assert_allclose(got, gather, rtol=1e-4, atol=1e-3)
+
+
+def test_row_padding_to_doc_block():
+    """B not a multiple of 8: rows are padded and the pad rows dropped."""
+    spec = VocabSpec(EXACT, (2,))
+    rng = np.random.default_rng(17)
+    weights = rng.normal(size=(spec.id_space_size, 2)).astype(np.float32)
+    docs = _random_docs(rng, 3, 120)
+    got = _pallas_scores(docs, weights, spec, pad_to=128)
+    want = S.score_batch_numpy(docs, weights, None, spec)
+    assert got.shape == (3, 2)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
+
+
+def test_supported_gate():
+    assert SP.pallas_supported(VocabSpec(EXACT, (1, 2)), 256 + 65536, 3)
+    assert not SP.pallas_supported(VocabSpec(EXACT, (1, 2, 3)), 10, 3)
+    assert not SP.pallas_supported(VocabSpec(HASHED, (1, 2)), 1 << 20, 3)
+    # compact (non-dense) table or too many languages
+    assert not SP.pallas_supported(VocabSpec(EXACT, (2,)), 100, 3)
+    assert not SP.pallas_supported(
+        VocabSpec(EXACT, (2,)), 256 + 65536, SP.MAX_PALLAS_LANGS + 1
+    )
+
+
+def test_runner_pallas_strategy_end_to_end():
+    """BatchRunner with strategy='pallas' (interpret on CPU) matches gather."""
+    from spark_languagedetector_tpu.api.runner import BatchRunner
+
+    spec = VocabSpec(EXACT, (1, 2))
+    rng = np.random.default_rng(19)
+    weights = rng.normal(size=(spec.id_space_size, 3)).astype(np.float32)
+    docs = _random_docs(rng, 10, 200) + [b"", b"q"]
+    pallas_runner = BatchRunner(
+        weights=jnp.asarray(weights), lut=None, spec=spec,
+        batch_size=8, strategy="pallas",
+    )
+    gather_runner = BatchRunner(
+        weights=jnp.asarray(weights), lut=None, spec=spec,
+        batch_size=8, strategy="gather",
+    )
+    np.testing.assert_allclose(
+        pallas_runner.score(docs), gather_runner.score(docs),
+        rtol=1e-4, atol=1e-3,
+    )

@@ -14,6 +14,7 @@ Public API::
     )
 """
 
+from .api.pipeline import Pipeline, PipelineModel
 from .api.table import Schema, Table
 from .models.language import ISO_LANGUAGE_CODES, Language
 
@@ -25,6 +26,8 @@ __all__ = [
     "LanguageDetector",
     "LanguageDetectorModel",
     "LowerCasePreprocessor",
+    "Pipeline",
+    "PipelineModel",
     "Schema",
     "SpecialCharPreprocessor",
     "Table",

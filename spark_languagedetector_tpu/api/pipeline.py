@@ -1,0 +1,80 @@
+"""Pipeline: chain preprocessors and an estimator into one fit/transform unit.
+
+The reference's classes are built to the Spark ML ``Pipeline`` contract —
+SURVEY.md §1 places them under "user code / Spark ML `Pipeline`" — but Spark
+supplies the chaining itself. A reference user migrating here gets the same
+composition surface: a ``Pipeline`` of transformer stages (anything with
+``transform``) and at most-any estimator stages (anything with ``fit``);
+``Pipeline.fit`` runs transformers forward, fits each estimator on the
+running dataset, and returns a ``PipelineModel`` of the fitted stages whose
+``transform`` replays the whole chain.
+
+Mirrors Spark's semantics: stages run in declaration order; an estimator's
+fitted model transforms the data before later stages see it; ``copy`` deep-
+copies the stage list.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..utils.identifiable import Identifiable
+
+
+class Pipeline(Identifiable):
+    """Estimator over an ordered list of stages (transformers/estimators)."""
+
+    def __init__(self, stages: Sequence[object], uid: str | None = None):
+        super().__init__(uid, uid_prefix="Pipeline")
+        for s in stages:
+            if not hasattr(s, "transform") and not hasattr(s, "fit"):
+                raise TypeError(
+                    f"pipeline stage {s!r} has neither transform nor fit"
+                )
+        self.stages = list(stages)
+
+    def fit(self, dataset) -> "PipelineModel":
+        fitted = []
+        current = dataset
+        for i, stage in enumerate(self.stages):
+            is_last = i == len(self.stages) - 1
+            if hasattr(stage, "fit"):
+                model = stage.fit(current)
+                fitted.append(model)
+                # Spark parity: the LAST stage's model never transforms the
+                # training data inside fit — only intermediate outputs feed
+                # later stages (labeled training tables usually already carry
+                # the model's output column, which transform must append).
+                if not is_last:
+                    current = model.transform(current)
+            else:
+                fitted.append(stage)
+                if not is_last:
+                    current = stage.transform(current)
+        return PipelineModel(fitted)
+
+    def copy(self, extra=None):
+        import copy as _copy
+
+        return Pipeline([_copy.deepcopy(s) for s in self.stages], uid=self.uid)
+
+
+class PipelineModel(Identifiable):
+    """Transformer chaining the fitted stages of a :class:`Pipeline`."""
+
+    def __init__(self, stages: Sequence[object], uid: str | None = None):
+        super().__init__(uid, uid_prefix="PipelineModel")
+        self.stages = list(stages)
+
+    def transform(self, dataset):
+        current = dataset
+        for stage in self.stages:
+            current = stage.transform(current)
+        return current
+
+    def copy(self, extra=None):
+        import copy as _copy
+
+        return PipelineModel(
+            [_copy.deepcopy(s) for s in self.stages], uid=self.uid
+        )

@@ -75,6 +75,13 @@ class _DetectorParams(HasInputCol, HasLabelCol):
         "text→bytes for fit: 'utf8' (reference fit behavior)",
         lambda v: v in (UTF8, LOW_BYTE),
     )
+    fit_backend = Param(
+        "fitBackend",
+        "'cpu' (host fit — the reference keeps fit on CPU) or 'device': "
+        "streaming dense-count fit on the jax default device "
+        "(micro-batched scatter-add + device weighting/top-k)",
+        lambda v: v in ("cpu", "device"),
+    )
 
 
 class LanguageDetector(_DetectorParams):
@@ -99,6 +106,7 @@ class LanguageDetector(_DetectorParams):
             hashBits=20,
             weightMode=fit_ops.PARITY,
             trainEncoding=UTF8,
+            fitBackend="cpu",
         )
         self.set("supportedLanguages", list(supported_languages))
         self.set("gramLengths", [int(n) for n in gram_lengths])
@@ -107,6 +115,9 @@ class LanguageDetector(_DetectorParams):
     # -- convenience setters (Spark ML style) ---------------------------------
     def set_save_grams_to(self, path: str | None):
         return self.set("saveGrams", path)
+
+    def set_fit_backend(self, value: str):
+        return self.set("fitBackend", value)
 
     def set_vocab_mode(self, mode: str):
         return self.set("vocabMode", mode)
@@ -164,14 +175,26 @@ class LanguageDetector(_DetectorParams):
         spec = self._vocab_spec()
         docs = texts_to_bytes(texts.tolist(), self.get("trainEncoding"))
         lang_idx = np.asarray([lang_to_idx[l] for l in label_list])
-        ids, weights = fit_ops.fit_profile_numpy(
-            docs,
-            lang_idx,
-            len(supported),
-            spec,
-            self.get("languageProfileSize"),
-            self.get("weightMode"),
-        )
+        if self.get("fitBackend") == "device":
+            from ..ops.fit_tpu import fit_profile_device
+
+            ids, weights = fit_profile_device(
+                docs,
+                lang_idx,
+                len(supported),
+                spec,
+                self.get("languageProfileSize"),
+                self.get("weightMode"),
+            )
+        else:
+            ids, weights = fit_ops.fit_profile_numpy(
+                docs,
+                lang_idx,
+                len(supported),
+                spec,
+                self.get("languageProfileSize"),
+                self.get("weightMode"),
+            )
         # Both modes store the compact columnar form (sorted unique ids +
         # weight rows); the device view picks dense-table vs LUT strategy.
         profile = GramProfile(

@@ -105,3 +105,65 @@ def fit_dense_step(
     return counts_acc + gram_counts_dense(
         batch, lengths, lang_ids, spec=spec, num_langs=num_langs
     )
+
+
+def fit_profile_device(
+    byte_docs,
+    lang_indices,
+    num_langs: int,
+    spec: VocabSpec,
+    profile_size: int,
+    weight_mode: str = "parity",
+    batch_rows: int = 512,
+):
+    """Full single-device fit: returns (sorted gram ids [G], weights [G, L]).
+
+    Mirrors :func:`ops.fit.fit_profile_numpy` exactly — candidate set =
+    grams occurring anywhere in the corpus; per language, top-k by
+    (weight desc, id asc); union of winners with full weight vectors — but
+    streams micro-batches through the jit-compiled dense counting step, so
+    the corpus never has to fit in memory at once and the count/weight/top-k
+    math runs on the accelerator. Only the compact winner rows come back to
+    the host (the reference's collect-to-driver step,
+    LanguageDetector.scala:252-254).
+    """
+    import numpy as np
+
+    from .encoding import DEFAULT_LENGTH_BUCKETS, bucket_length, pad_batch
+
+    V = spec.id_space_size
+    counts = jnp.zeros((V, num_langs), dtype=jnp.int32)
+    lang_arr = np.asarray(lang_indices, dtype=np.int32)
+    order = np.argsort([len(d) for d in byte_docs], kind="stable")
+    max_bucket = DEFAULT_LENGTH_BUCKETS[-1]
+    for start in range(0, len(order), batch_rows):
+        sel = order[start : start + batch_rows]
+        docs = [byte_docs[i] for i in sel]
+        longest = max((len(d) for d in docs), default=1)
+        if longest <= max_bucket:
+            pad_to = bucket_length(longest, DEFAULT_LENGTH_BUCKETS)
+        else:  # oversized docs: round up (recompiles per distinct width)
+            pad_to = -(-longest // 2048) * 2048
+        batch, lengths = pad_batch(docs, pad_to=pad_to)
+        counts = fit_dense_step(
+            jnp.asarray(batch),
+            jnp.asarray(lengths),
+            jnp.asarray(lang_arr[sel]),
+            counts,
+            spec=spec,
+            num_langs=num_langs,
+        )
+
+    dense_w = weights_from_counts(counts, weight_mode=weight_mode)
+    occurred = counts.sum(axis=1) > 0
+    # Non-occurred rows are not candidates (the reference's table only holds
+    # grams seen in training); mask them below any real weight for top-k.
+    masked = jnp.where(occurred[:, None], dense_w, -jnp.inf)
+    k = min(profile_size, V)
+    top = top_k_rows(masked, k=k)  # [L, k]; lax.top_k ties → lowest id
+
+    top_np = np.unique(np.asarray(top).reshape(-1))
+    occurred_np = np.asarray(occurred[jnp.asarray(top_np)])
+    rows = top_np[occurred_np]  # dense row index == gram id
+    weights = np.asarray(dense_w[jnp.asarray(rows)], dtype=np.float64)
+    return rows.astype(np.int64), weights

@@ -1,0 +1,75 @@
+"""Device fit parity: streaming dense-count fit == host numpy fit."""
+
+import numpy as np
+import pytest
+
+from spark_languagedetector_tpu import LanguageDetector, Table
+from spark_languagedetector_tpu.ops.fit import PARITY, COUNTS, fit_profile_numpy
+from spark_languagedetector_tpu.ops.fit_tpu import fit_profile_device
+from spark_languagedetector_tpu.ops.vocab import EXACT, HASHED, VocabSpec
+
+
+def _corpus(rng, n_docs, n_langs, max_len=120):
+    docs, langs = [], []
+    for i in range(n_docs):
+        ln = int(rng.integers(0, max_len))
+        docs.append(bytes(rng.integers(97, 105, ln, dtype=np.uint8)))
+        langs.append(i % n_langs)
+    return docs, np.asarray(langs)
+
+
+@pytest.mark.parametrize(
+    "spec,weight_mode",
+    [
+        (VocabSpec(EXACT, (1, 2)), PARITY),
+        (VocabSpec(EXACT, (2,)), COUNTS),
+        (VocabSpec(HASHED, (1, 2, 3), hash_bits=12), PARITY),
+    ],
+)
+def test_matches_numpy_fit(spec, weight_mode):
+    rng = np.random.default_rng(3)
+    docs, langs = _corpus(rng, 40, 3)
+    docs += [b"", b"x"]  # empty + shorter-than-gram docs
+    langs = np.concatenate([langs, [0, 1]])
+    want_ids, want_w = fit_profile_numpy(docs, langs, 3, spec, 25, weight_mode)
+    got_ids, got_w = fit_profile_device(
+        docs, langs, 3, spec, 25, weight_mode, batch_rows=16
+    )
+    np.testing.assert_array_equal(got_ids, want_ids)
+    np.testing.assert_allclose(got_w, want_w, rtol=1e-6, atol=1e-7)
+
+
+def test_profile_size_larger_than_vocab():
+    """profile_size > #occurring grams keeps exactly the occurring grams."""
+    spec = VocabSpec(EXACT, (1,))
+    docs = [b"ab", b"ba", b"c"]
+    langs = np.asarray([0, 0, 1])
+    got_ids, _ = fit_profile_device(docs, langs, 2, spec, 10_000)
+    want_ids, _ = fit_profile_numpy(docs, langs, 2, spec, 10_000)
+    np.testing.assert_array_equal(got_ids, want_ids)
+    assert set(got_ids.tolist()) == {ord("a"), ord("b"), ord("c")}
+
+
+def test_estimator_fit_backend_device_end_to_end():
+    rows = {
+        "lang": ["de"] * 3 + ["en"] * 3,
+        "fulltext": [
+            "der schnelle braune fuchs",
+            "das ist ja sehr schön",
+            "noch ein deutscher satz",
+            "the quick brown fox",
+            "that is very nice",
+            "one more english sentence",
+        ],
+    }
+    cpu = LanguageDetector(["de", "en"], [2], 100).fit(Table(rows))
+    dev = (
+        LanguageDetector(["de", "en"], [2], 100)
+        .set_fit_backend("device")
+        .fit(Table(rows))
+    )
+    assert set(dev.gram_probabilities) == set(cpu.gram_probabilities)
+    for g, v in cpu.gram_probabilities.items():
+        np.testing.assert_allclose(dev.gram_probabilities[g], v, rtol=1e-6)
+    out = dev.transform(Table({"fulltext": ["ein schöner deutscher text"]}))
+    assert list(out.column("lang")) == ["de"]

@@ -1,0 +1,75 @@
+"""Pipeline composition: preprocessors + estimator as one fit/transform."""
+
+import pytest
+
+from spark_languagedetector_tpu import (
+    LanguageDetector,
+    LowerCasePreprocessor,
+    Pipeline,
+    PipelineModel,
+    SpecialCharPreprocessor,
+    Table,
+)
+
+LANGS = ["de", "en"]
+ROWS = {
+    "lang": ["de"] * 4 + ["en"] * 4,
+    "fulltext": [
+        "Dies ist ein (deutscher) Text",
+        "Das ist ja SEHR schön",
+        "Dieser Text ist auch deutsch",
+        "Und noch ein deutscher Satz",
+        "This is an {english} text",
+        "That is VERY nice indeed",
+        "This text is also english",
+        "And one more english sentence",
+    ],
+}
+
+
+def _pipeline():
+    lower = LowerCasePreprocessor()
+    lower.set_input_col("fulltext")
+    clean = SpecialCharPreprocessor()
+    clean.set_input_col("fulltext")
+    det = LanguageDetector(LANGS, [2, 3], 50)
+    return Pipeline([lower, clean, det])
+
+
+def test_fit_transform_chain():
+    model = _pipeline().fit(Table(ROWS))
+    assert isinstance(model, PipelineModel)
+    # The LowerCasePreprocessor derives its locale from the label column
+    # (reference quirk Q8 — usable only on labeled data), so the inference
+    # table carries labels too; the detector writes a distinct column.
+    model.stages[-1].set("outputCol", "detected")
+    out = model.transform(
+        Table({
+            "lang": ["de", "en"],
+            "fulltext": ["Schöner (Text)", "nice {text}"],
+        })
+    )
+    assert list(out.column("detected")) == ["de", "en"]
+
+
+def test_preprocessors_applied_before_fit():
+    """The detector must see lowercased, symbol-stripped text."""
+    model = _pipeline().fit(Table(ROWS))
+    det_model = model.stages[-1]
+    grams = set(det_model.gram_probabilities)
+    # Uppercase bytes cannot survive the LowerCasePreprocessor.
+    assert not any(any(0x41 <= b <= 0x5A for b in g) for g in grams)
+    # Stripped symbols cannot appear in learned grams.
+    assert not any(b"(" in g or b"{" in g for g in grams)
+
+
+def test_transformers_only_pipeline():
+    p = Pipeline([SpecialCharPreprocessor().set_input_col("fulltext")])
+    model = p.fit(Table({"fulltext": ["a (b) c"]}))
+    out = model.transform(Table({"fulltext": ["x (y) z"]}))
+    assert list(out.column("fulltext")) == ["x y z"]
+
+
+def test_invalid_stage_rejected():
+    with pytest.raises(TypeError):
+        Pipeline([object()])

@@ -9,9 +9,11 @@ composition surface: a ``Pipeline`` of transformer stages (anything with
 running dataset, and returns a ``PipelineModel`` of the fitted stages whose
 ``transform`` replays the whole chain.
 
-Mirrors Spark's semantics: stages run in declaration order; an estimator's
-fitted model transforms the data before later stages see it; ``copy`` deep-
-copies the stage list.
+Mirrors Spark's semantics: stages run in declaration order; only stages
+strictly before the last estimator transform the training data during fit
+(the last estimator's model and any stages after it are collected into the
+``PipelineModel`` without running on the training table); ``copy``
+deep-copies the stage list.
 """
 
 from __future__ import annotations
@@ -34,22 +36,27 @@ class Pipeline(Identifiable):
         self.stages = list(stages)
 
     def fit(self, dataset) -> "PipelineModel":
+        # Spark parity (org.apache.spark.ml.Pipeline.fit): only stages
+        # strictly BEFORE the last estimator transform the training data
+        # inside fit. The last estimator is fitted but its model never runs on
+        # the training table (which usually already carries the label column
+        # the model's transform would append), and stages after it are plain
+        # transformers collected into the PipelineModel without being applied.
+        last_fit = max(
+            (i for i, s in enumerate(self.stages) if hasattr(s, "fit")),
+            default=-1,
+        )
         fitted = []
         current = dataset
         for i, stage in enumerate(self.stages):
-            is_last = i == len(self.stages) - 1
             if hasattr(stage, "fit"):
                 model = stage.fit(current)
                 fitted.append(model)
-                # Spark parity: the LAST stage's model never transforms the
-                # training data inside fit — only intermediate outputs feed
-                # later stages (labeled training tables usually already carry
-                # the model's output column, which transform must append).
-                if not is_last:
+                if i < last_fit:
                     current = model.transform(current)
             else:
                 fitted.append(stage)
-                if not is_last:
+                if i < last_fit:
                     current = stage.transform(current)
         return PipelineModel(fitted)
 

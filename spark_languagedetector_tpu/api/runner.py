@@ -82,7 +82,11 @@ class BatchRunner:
     spec: VocabSpec
     batch_size: int | None = None  # None ⇒ auto per strategy
     length_buckets: tuple[int, ...] = DEFAULT_LENGTH_BUCKETS
+    # Window-axis scan block for the XLA strategies (gather/onehot) only;
+    # the pallas kernel's window block is `pallas_block` (None ⇒ the kernel's
+    # own default).
     block: int = score_ops.DEFAULT_BLOCK
+    pallas_block: int | None = None
     device: object | None = None  # jax device; None ⇒ process default
     strategy: str = "auto"  # 'auto' | 'gather' | 'onehot' | 'pallas'
     metrics: Metrics = field(default_factory=Metrics)
@@ -121,22 +125,12 @@ class BatchRunner:
                 "strategy='onehot' needs an exact vocab with gram lengths <= "
                 f"{score_ops.ONEHOT_MAX_N} and the dense weight table"
             )
-        if self.strategy == "pallas":
-            if not pallas_ok:
-                raise ValueError(
-                    "strategy='pallas' needs an exact vocab with gram lengths "
-                    "<= 2, the dense weight table, and at most "
-                    f"{score_pallas.MAX_PALLAS_LANGS} languages"
-                )
-            target = self.device or jax.devices()[0]
-            # Mosaic only lowers on TPU; anywhere else (CPU tests, GPU) the
-            # explicit pallas strategy runs in interpret mode.
-            self._pallas_interpret = target.platform != "tpu"
-            w1, w2 = score_pallas.weight_views(self.weights, self.spec)
-            if self.device is not None:
-                w1 = jax.device_put(w1, self.device)
-                w2 = jax.device_put(w2, self.device)
-            self._pallas_w1, self._pallas_w2 = w1, w2
+        if self.strategy == "pallas" and not pallas_ok:
+            raise ValueError(
+                "strategy='pallas' needs an exact vocab with gram lengths "
+                "<= 2, the dense weight table, and at most "
+                f"{score_pallas.MAX_PALLAS_LANGS} languages"
+            )
         if self.batch_size is None:
             self.batch_size = (
                 DEFAULT_PALLAS_BATCH_SIZE
@@ -152,6 +146,31 @@ class BatchRunner:
     @property
     def max_chunk(self) -> int:
         return self.length_buckets[-1]
+
+    def _pallas_state(self):
+        """(interpret, w1, w2) for the pallas strategy, built lazily so the
+        strategy can be selected after construction too."""
+        state = getattr(self, "_pallas_cache", None)
+        if state is None:
+            # Re-validate here: __post_init__ only checks the strategy it saw
+            # at construction, and strategy may have been mutated since.
+            if self.lut is not None or not score_pallas.pallas_supported(
+                self.spec, self.weights.shape[0], self.weights.shape[1]
+            ):
+                raise ValueError(
+                    "strategy='pallas' needs an exact vocab with gram "
+                    "lengths <= 2 and the dense weight table"
+                )
+            target = self.device or jax.devices()[0]
+            # Mosaic only lowers on TPU; anywhere else (CPU tests, GPU) the
+            # explicit pallas strategy runs in interpret mode.
+            interpret = target.platform != "tpu"
+            w1, w2 = score_pallas.weight_views(self.weights, self.spec)
+            if self.device is not None:
+                w1 = jax.device_put(w1, self.device)
+                w2 = jax.device_put(w2, self.device)
+            state = self._pallas_cache = (interpret, w1, w2)
+        return state
 
     @staticmethod
     def _pack(batch_docs, pad_to: int):
@@ -219,14 +238,16 @@ class BatchRunner:
                 if window_limit is not None:
                     window_limit = jax.device_put(window_limit, self.device)
                 if self.strategy == "pallas":
+                    interpret, w1, w2 = self._pallas_state()
                     scores = score_pallas.score_batch_pallas(
                         batch,
                         lengths,
-                        self._pallas_w1,
-                        self._pallas_w2,
+                        w1,
+                        w2,
                         window_limit,
                         spec=self.spec,
-                        interpret=self._pallas_interpret,
+                        block=self.pallas_block or score_pallas.DEFAULT_BLOCK,
+                        interpret=interpret,
                     )
                 elif self.strategy == "onehot":
                     scores = score_ops.score_batch_onehot(

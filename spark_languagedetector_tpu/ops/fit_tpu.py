@@ -118,14 +118,22 @@ def fit_profile_device(
 ):
     """Full single-device fit: returns (sorted gram ids [G], weights [G, L]).
 
-    Mirrors :func:`ops.fit.fit_profile_numpy` exactly — candidate set =
-    grams occurring anywhere in the corpus; per language, top-k by
-    (weight desc, id asc); union of winners with full weight vectors — but
-    streams micro-batches through the jit-compiled dense counting step, so
-    the corpus never has to fit in memory at once and the count/weight/top-k
-    math runs on the accelerator. Only the compact winner rows come back to
-    the host (the reference's collect-to-driver step,
-    LanguageDetector.scala:252-254).
+    Mirrors :func:`ops.fit.fit_profile_numpy` — candidate set = grams
+    occurring anywhere in the corpus; per language, top-k by (weight desc,
+    id asc); union of winners with full weight vectors — but streams
+    micro-batches through the jit-compiled dense counting step, so the corpus
+    never has to fit in memory at once and the count/weight/top-k math runs
+    on the accelerator. Only the compact winner rows come back to the host
+    (the reference's collect-to-driver step, LanguageDetector.scala:252-254).
+
+    Precision: counts accumulate in int32 on device — exact up to 2^31-1
+    occurrences per (gram, language) per fit; corpora beyond that need the
+    host fit (int64 throughout). Winner *weights* are recomputed on host in
+    float64 from the exact integer counts, so the returned weights match the
+    host fit bit-for-bit; only the top-k *selection* happens at float32
+    precision, which can pick a different winner when two grams' weights
+    differ by less than one f32 ulp (only possible in 'counts' mode — parity
+    weights take |L|+1 discrete values).
     """
     import numpy as np
 
@@ -165,5 +173,15 @@ def fit_profile_device(
     top_np = np.unique(np.asarray(top).reshape(-1))
     occurred_np = np.asarray(occurred[jnp.asarray(top_np)])
     rows = top_np[occurred_np]  # dense row index == gram id
-    weights = np.asarray(dense_w[jnp.asarray(rows)], dtype=np.float64)
+    # Recompute winner weights on host in float64 from the exact integer
+    # counts (see docstring) instead of fetching the device's float32 table.
+    counts_rows = np.asarray(counts[jnp.asarray(rows)], dtype=np.int64)
+    if weight_mode == "parity":
+        present = counts_rows > 0
+        nlangs = present.sum(axis=1, keepdims=True)
+        ratio = np.where(present, 1.0 / np.maximum(nlangs, 1), 0.0)
+    else:
+        totals = counts_rows.sum(axis=1, keepdims=True)
+        ratio = counts_rows / np.maximum(totals, 1)
+    weights = np.log1p(ratio.astype(np.float64))
     return rows.astype(np.int64), weights

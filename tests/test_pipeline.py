@@ -73,3 +73,31 @@ def test_transformers_only_pipeline():
 def test_invalid_stage_rejected():
     with pytest.raises(TypeError):
         Pipeline([object()])
+
+
+def test_stage_after_estimator_not_applied_during_fit():
+    """Spark parity: stages after the last estimator are collected into the
+    PipelineModel without running on the training table. With the detector's
+    default outputCol 'lang' equal to the label column, applying the fitted
+    model during fit would crash with 'column lang already exists'."""
+    lower = LowerCasePreprocessor()
+    lower.set_input_col("fulltext")
+    det = LanguageDetector(LANGS, [2, 3], 50)
+    post = SpecialCharPreprocessor()
+    post.set_input_col("fulltext")
+    model = Pipeline([det, post]).fit(Table(ROWS))  # must not raise
+    assert len(model.stages) == 2
+    model.stages[0].set("outputCol", "detected")
+    out = model.transform(Table({"fulltext": ["Dies ist ein deutscher Text"]}))
+    assert list(out.column("detected")) == ["de"]
+
+
+def test_transformer_before_estimator_only_applies_to_prefix():
+    """A transformer before the last estimator transforms the training data;
+    the estimator itself is last and its model must not run during fit."""
+    lower = LowerCasePreprocessor()
+    lower.set_input_col("fulltext")
+    det = LanguageDetector(LANGS, [2, 3], 50)
+    model = Pipeline([lower, det]).fit(Table(ROWS))
+    grams = set(model.stages[-1].gram_probabilities)
+    assert not any(any(0x41 <= b <= 0x5A for b in g) for g in grams)

@@ -1,0 +1,83 @@
+"""Real-TPU parity tests (opt-in: SLD_TPU_TESTS=1).
+
+The rest of the suite pins JAX to the CPU backend (conftest), so the Mosaic
+lowering of the pallas kernels — 128-aligned lane slices, rank-2
+intermediates, SMEM scalar arrays — is never exercised in-process. These
+tests spawn a subprocess WITHOUT the CPU pin and compare the compiled pallas
+kernel against the gather strategy on the real device (ADVICE round 1: a
+Mosaic regression must not first surface at runtime on hardware).
+
+Opt-in rather than auto-detected because probing a tunneled TPU can block for
+minutes when the tunnel is unhealthy; CI with local chips sets SLD_TPU_TESTS=1.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("SLD_TPU_TESTS") != "1",
+    reason="real-TPU tests are opt-in: set SLD_TPU_TESTS=1",
+)
+
+_PARITY_SCRIPT = r"""
+import json, sys
+import numpy as np
+import jax, jax.numpy as jnp
+
+if jax.default_backend() == "cpu":
+    print(json.dumps({"skip": "no accelerator"}))
+    sys.exit(0)
+
+from spark_languagedetector_tpu.ops import score as S
+from spark_languagedetector_tpu.ops import score_pallas as SP
+from spark_languagedetector_tpu.ops.encoding import pad_batch
+from spark_languagedetector_tpu.ops.vocab import EXACT, VocabSpec
+
+spec = VocabSpec(EXACT, (1, 2))
+rng = np.random.default_rng(23)
+weights = rng.normal(size=(spec.id_space_size, 5)).astype(np.float32)
+docs = [b"", b"a", b"ab"] + [
+    bytes(rng.integers(0, 256, int(rng.integers(1, 700)), dtype=np.uint8))
+    for _ in range(29)
+]
+batch, lengths = pad_batch(docs, pad_to=1024)
+batch, lengths = jnp.asarray(batch), jnp.asarray(lengths)
+w = jnp.asarray(weights)
+w1, w2 = SP.weight_views(w, spec)
+
+got = np.asarray(
+    SP.score_batch_pallas(batch, lengths, w1, w2, None, spec=spec)
+)
+want = np.asarray(S.score_batch(batch, lengths, w, None, spec=spec))
+err = float(np.abs(got - want).max())
+print(json.dumps({"max_abs_err": err, "backend": jax.default_backend()}))
+"""
+
+
+def _run_on_device(script: str) -> dict:
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env["XLA_FLAGS"] = ""  # no virtual-device forcing
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=900,
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, (
+        f"device subprocess failed:\nstdout: {proc.stdout[-1000:]}\n"
+        f"stderr: {proc.stderr[-4000:]}"
+    )
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_pallas_matches_gather_on_hardware():
+    result = _run_on_device(_PARITY_SCRIPT)
+    if "skip" in result:
+        pytest.skip(result["skip"])
+    assert result["max_abs_err"] < 1e-2

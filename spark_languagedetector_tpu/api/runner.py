@@ -69,6 +69,44 @@ def resolve_device(backend: str):
     )
 
 
+def resolve_mesh(backend: str):
+    """Device mesh for a backend param value, or None for single-device.
+
+    'mesh' always builds a data-parallel mesh over every visible device of
+    the preferred platform (accelerators when present, else host CPUs —
+    e.g. the 8-virtual-device test substrate). 'auto' builds one only when
+    MORE than one accelerator is visible, so single-chip and CPU-test
+    behavior keep the simple single-device dispatch path. The reference's
+    ``transform`` is cluster-parallel by default
+    (LanguageDetectorModel.scala:219-240 — ``Dataset.map`` over partitions);
+    this is that default, TPU-native.
+    """
+    from ..parallel.mesh import build_mesh
+
+    accel = [d for d in jax.devices() if d.platform != "cpu"]
+    if backend == "mesh":
+        devices = accel or jax.devices("cpu")
+        return build_mesh(data=len(devices), vocab=1, devices=devices)
+    if backend == "auto" and len(accel) > 1:
+        return build_mesh(data=len(accel), vocab=1, devices=accel)
+    return None
+
+
+def resolve_fit_mesh():
+    """Mesh for ``fitBackend="device"``: every visible device when more than
+    one (accelerators preferred, else the CPU test substrate), None on a
+    single device. One policy site shared with :func:`resolve_mesh`'s device
+    preference so the fit and transform paths can't drift."""
+    from ..parallel.mesh import build_mesh
+
+    devices = [d for d in jax.devices() if d.platform != "cpu"]
+    if len(devices) < 2:
+        devices = jax.devices()
+    if len(devices) < 2:
+        return None
+    return build_mesh(data=len(devices), vocab=1, devices=devices)
+
+
 @dataclass
 class BatchRunner:
     """Scores arbitrary document collections through fixed-shape micro-batches.
@@ -88,11 +126,25 @@ class BatchRunner:
     block: int = score_ops.DEFAULT_BLOCK
     pallas_block: int | None = None
     device: object | None = None  # jax device; None ⇒ process default
+    # Data-parallel device mesh (jax.sharding.Mesh). When set, micro-batches
+    # are sharded over the mesh's "data" axis and the weight table is
+    # replicated; GSPMD partitions the jitted scorer across all devices.
+    # Mutually exclusive with `device`.
+    mesh: object | None = None
     strategy: str = "auto"  # 'auto' | 'gather' | 'onehot' | 'pallas'
     metrics: Metrics = field(default_factory=Metrics)
 
     def __post_init__(self):
-        if self.device is not None:
+        if self.mesh is not None:
+            if self.device is not None:
+                raise ValueError("pass either device or mesh, not both")
+            from ..parallel.mesh import DATA_AXIS, replicated
+
+            self._ndata = int(self.mesh.shape[DATA_AXIS])
+            self.weights = jax.device_put(self.weights, replicated(self.mesh))
+            if self.lut is not None:
+                self.lut = jax.device_put(self.lut, replicated(self.mesh))
+        elif self.device is not None:
             self.weights = jax.device_put(self.weights, self.device)
             if self.lut is not None:
                 self.lut = jax.device_put(self.lut, self.device)
@@ -109,7 +161,12 @@ class BatchRunner:
             # qualifies (exact grams ⊆ {1,2}, dense table, few languages);
             # one-hot MXU via XLA otherwise-qualifying on CPU (pallas
             # interpret mode is far too slow outside tests); gather fallback.
-            target = self.device or jax.devices()[0]
+            # On a mesh the XLA strategies partition via GSPMD and the pallas
+            # kernel runs per-shard under shard_map — all three qualify.
+            if self.mesh is not None:
+                target = list(self.mesh.devices.flat)[0]
+            else:
+                target = self.device or jax.devices()[0]
             if pallas_ok and target.platform == "tpu":
                 self.strategy = "pallas"
             elif self.lut is None and score_ops.onehot_supported(
@@ -172,6 +229,50 @@ class BatchRunner:
             state = self._pallas_cache = (interpret, w1, w2)
         return state
 
+    def _full_limit(self, rows: int, placement):
+        """Cached no-op window-limit device array (mesh-pallas needs the
+        operand even when no doc is chunked; only a handful of distinct row
+        counts exist, so don't pay a h2d transfer per micro-batch)."""
+        cache = getattr(self, "_full_limit_cache", None)
+        if cache is None:
+            cache = self._full_limit_cache = {}
+        arr = cache.get(rows)
+        if arr is None:
+            arr = cache[rows] = jax.device_put(
+                np.full(rows, self.max_chunk, np.int32), placement
+            )
+        return arr
+
+    def _mesh_pallas_fn(self, interpret: bool):
+        """shard_map wrapper running the pallas kernel on each data shard."""
+        fn = getattr(self, "_mesh_pallas_cache", None)
+        if fn is None:
+            from jax.sharding import PartitionSpec as P
+
+            from ..parallel.mesh import DATA_AXIS
+
+            spec = self.spec
+            block = self.pallas_block or score_pallas.DEFAULT_BLOCK
+
+            def local(batch, lengths, w1, w2, lim):
+                return score_pallas.score_batch_pallas(
+                    batch, lengths, w1, w2, lim,
+                    spec=spec, block=block, interpret=interpret,
+                )
+
+            fn = self._mesh_pallas_cache = jax.jit(
+                jax.shard_map(
+                    local,
+                    mesh=self.mesh,
+                    in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P(), P(DATA_AXIS)),
+                    out_specs=P(DATA_AXIS),
+                    # pallas_call's out_shape carries no varying-mesh-axes
+                    # info; the kernel is per-shard pure, so skip the check.
+                    check_vma=False,
+                )
+            )
+        return fn
+
     @staticmethod
     def _pack(batch_docs, pad_to: int):
         """Padded packing: native C++ loader (falls back to numpy internally)."""
@@ -189,6 +290,14 @@ class BatchRunner:
 
         overlap = max(self.spec.gram_lengths) - 1
         stride = self.max_chunk - overlap
+        # Loop-invariant placement: a NamedSharding on the mesh (GSPMD
+        # partitions the jitted scorer from it) or the single target device.
+        if self.mesh is not None:
+            from ..parallel.mesh import batch_sharding, pad_rows_for_mesh
+
+            placement = batch_sharding(self.mesh)
+        else:
+            placement = self.device
 
         # Expand long docs into chunks; each work item is
         # (doc_index, chunk_bytes, owned_window_starts).
@@ -215,12 +324,21 @@ class BatchRunner:
             for start in range(0, len(order), self.batch_size):
                 sel = order[start : start + self.batch_size]
                 batch_docs = [chunks[k] for k in sel]
+                batch_limits = [limits[k] for k in sel]
+                if self.mesh is not None:
+                    # Sharded dispatch needs the row count divisible by the
+                    # data axis; empty-doc pad rows score zero and are
+                    # dropped below (scatter uses only the first len(sel)).
+                    batch_docs, batch_limits = pad_rows_for_mesh(
+                        batch_docs,
+                        self._ndata,
+                        (batch_limits, self.max_chunk),
+                    )
                 pad_to = bucket_length(
                     max((len(d) for d in batch_docs), default=1),
                     self.length_buckets,
                 )
                 batch, lengths = self._pack(batch_docs, pad_to)
-                batch_limits = [limits[k] for k in sel]
                 # Batches without chunked docs (the common case) skip the
                 # window-limit array entirely — one fewer host→device
                 # transfer and a simpler compiled program.
@@ -232,23 +350,38 @@ class BatchRunner:
                 # into the jitted call makes the h2d copy synchronous on the
                 # dispatch path (~8.7ms/batch over a tunneled TPU, measured),
                 # while device_put returns immediately and overlaps the copy
-                # with packing the next batch (~0.2ms dispatch).
-                batch = jax.device_put(batch, self.device)
-                lengths = jax.device_put(lengths, self.device)
+                # with packing the next batch (~0.2ms dispatch). On a mesh
+                # the same put carries the data-axis sharding and GSPMD
+                # partitions the jitted scorer across devices.
+                batch = jax.device_put(batch, placement)
+                lengths = jax.device_put(lengths, placement)
                 if window_limit is not None:
-                    window_limit = jax.device_put(window_limit, self.device)
+                    window_limit = jax.device_put(window_limit, placement)
                 if self.strategy == "pallas":
                     interpret, w1, w2 = self._pallas_state()
-                    scores = score_pallas.score_batch_pallas(
-                        batch,
-                        lengths,
-                        w1,
-                        w2,
-                        window_limit,
-                        spec=self.spec,
-                        block=self.pallas_block or score_pallas.DEFAULT_BLOCK,
-                        interpret=interpret,
-                    )
+                    if self.mesh is not None:
+                        # pallas_call has no GSPMD partitioning rule; run the
+                        # kernel per-shard under shard_map (weights
+                        # replicated, batch split over the data axis).
+                        if window_limit is None:
+                            window_limit = self._full_limit(
+                                batch.shape[0], placement
+                            )
+                        scores = self._mesh_pallas_fn(interpret)(
+                            batch, lengths, w1, w2, window_limit
+                        )
+                    else:
+                        scores = score_pallas.score_batch_pallas(
+                            batch,
+                            lengths,
+                            w1,
+                            w2,
+                            window_limit,
+                            spec=self.spec,
+                            block=self.pallas_block
+                            or score_pallas.DEFAULT_BLOCK,
+                            interpret=interpret,
+                        )
                 elif self.strategy == "onehot":
                     scores = score_ops.score_batch_onehot(
                         batch,
@@ -284,11 +417,12 @@ class BatchRunner:
             all_host = np.asarray(all_scores)
             doc_idx_arr = np.asarray(doc_idx, dtype=np.int64)
             offset = 0
-            for sel, _ in pending:
+            for sel, s in pending:
+                # Rows beyond len(sel) are mesh pad rows — dropped here.
                 np.add.at(
                     out, doc_idx_arr[sel], all_host[offset : offset + len(sel)]
                 )
-                offset += len(sel)
+                offset += s.shape[0]
 
         self.metrics.incr("docs_scored", N)
         log_event(

@@ -115,6 +115,7 @@ def fit_profile_device(
     profile_size: int,
     weight_mode: str = "parity",
     batch_rows: int = 512,
+    mesh=None,
 ):
     """Full single-device fit: returns (sorted gram ids [G], weights [G, L]).
 
@@ -134,6 +135,11 @@ def fit_profile_device(
     precision, which can pick a different winner when two grams' weights
     differ by less than one f32 ulp (only possible in 'counts' mode — parity
     weights take |L|+1 discrete values).
+
+    ``mesh``: optional ``jax.sharding.Mesh`` — batches shard over its "data"
+    axis and the count table stays replicated; GSPMD inserts the cross-shard
+    psum (the TPU-native analog of the reference's groupByKey shuffles,
+    LanguageDetector.scala:52-66). Pad rows (empty docs) contribute nothing.
     """
     import numpy as np
 
@@ -141,22 +147,40 @@ def fit_profile_device(
 
     V = spec.id_space_size
     counts = jnp.zeros((V, num_langs), dtype=jnp.int32)
+    step = fit_dense_step
+    ndata = 1
+    if mesh is not None:
+        from ..parallel.mesh import DATA_AXIS, replicated
+        from ..parallel.sharded import make_sharded_fit_step
+
+        ndata = int(mesh.shape[DATA_AXIS])
+        counts = jax.device_put(counts, replicated(mesh))
+        sharded = make_sharded_fit_step(mesh, spec, num_langs, shard_vocab=False)
+
+        def step(batch, lengths, lang_ids, acc, **_):
+            return sharded(batch, lengths, lang_ids, acc)
+
     lang_arr = np.asarray(lang_indices, dtype=np.int32)
     order = np.argsort([len(d) for d in byte_docs], kind="stable")
     max_bucket = DEFAULT_LENGTH_BUCKETS[-1]
     for start in range(0, len(order), batch_rows):
         sel = order[start : start + batch_rows]
         docs = [byte_docs[i] for i in sel]
+        langs = lang_arr[sel]
+        if ndata > 1:
+            from ..parallel.mesh import pad_rows_for_mesh
+
+            docs, langs = pad_rows_for_mesh(docs, ndata, (langs, 0))
         longest = max((len(d) for d in docs), default=1)
         if longest <= max_bucket:
             pad_to = bucket_length(longest, DEFAULT_LENGTH_BUCKETS)
         else:  # oversized docs: round up (recompiles per distinct width)
             pad_to = -(-longest // 2048) * 2048
         batch, lengths = pad_batch(docs, pad_to=pad_to)
-        counts = fit_dense_step(
+        counts = step(
             jnp.asarray(batch),
             jnp.asarray(lengths),
-            jnp.asarray(lang_arr[sel]),
+            jnp.asarray(langs),
             counts,
             spec=spec,
             num_langs=num_langs,

@@ -68,3 +68,24 @@ def vocab_sharding(mesh: Mesh) -> NamedSharding:
 
 def pad_to_multiple(n: int, k: int) -> int:
     return -(-n // k) * k
+
+
+def pad_rows_for_mesh(docs: list, ndata: int, *fill_lists):
+    """Pad a doc list (and parallel per-row metadata lists) to a multiple of
+    the data-axis size with empty rows. Empty docs (length 0) contribute
+    nothing to scoring or counting, so pad rows are semantically inert; the
+    caller drops their output rows. Returns (docs, *fill_lists) extended.
+
+    ``fill_lists`` are (list, pad_value) pairs.
+    """
+    short = len(docs) % ndata
+    if not short:
+        return (docs, *[lst for lst, _ in fill_lists])
+    pad = ndata - short
+    out = [docs + [b""] * pad]
+    for lst, value in fill_lists:
+        if isinstance(lst, np.ndarray):
+            out.append(np.concatenate([lst, np.full(pad, value, lst.dtype)]))
+        else:
+            out.append(list(lst) + [value] * pad)
+    return tuple(out)

@@ -44,10 +44,16 @@ _log = get_logger("api.runner")
 DEFAULT_BATCH_SIZE = 256
 # The fused pallas kernel keeps per-document state in VMEM scratch (no
 # O(B·vocab) HBM buffers), so its sweet spot is much larger micro-batches —
-# fewer dispatches amortize the per-call host/tunnel overhead. 4096×2048
-# bytes ≈ 8MB per transfer, under the tunneled-TPU h2d bandwidth cliff
-# (measured ~770MB/s ≤8MB vs ~210MB/s at 32MB).
+# fewer dispatches amortize the per-call host/tunnel overhead.
 DEFAULT_PALLAS_BATCH_SIZE = 4096
+# Hard cap on a single micro-batch's padded bytes. Once a program has
+# executed, h2d transfers ride the real device link (a tunneled relay here:
+# ~30-90MB/s, bursty; pre-execution puts only stage locally and measure
+# misleadingly fast). End-to-end A/B on the config-1 bench: 4096×2048 = 8MB
+# batches beat both many smaller puts (per-transfer overhead) and 16MB
+# batches (coarser transfer/compute overlap) — 0.37s vs 0.48-0.71s per
+# 20k-doc pass.
+MAX_BATCH_BYTES = 8 << 20
 
 
 def resolve_device(backend: str):
@@ -317,12 +323,44 @@ class BatchRunner:
                     # Non-final chunks own starts [0, stride); final owns all.
                     limits.append(stride if j < len(parts) - 1 else self.max_chunk)
 
-        # Bucket by padded length, then emit fixed-size batches per bucket.
-        order = np.argsort([len(c) for c in chunks], kind="stable")
+        # Group chunks by padded-length bucket, then emit batches per bucket
+        # with the row count capped so no single transfer exceeds
+        # MAX_BATCH_BYTES — a batch of 8192-wide rows at the full pallas
+        # batch size would be a 32MB transfer, past the h2d bandwidth cliff.
+        # A bucket's ragged remainder is carried into the next (wider) bucket
+        # instead of becoming its own under-filled batch: padding a few docs
+        # up one bucket is far cheaper than an extra dispatch + compile
+        # shape, and the whole call ends with at most one ragged tail batch.
+        by_bucket: dict[int, list[int]] = {}
+        for k in range(len(chunks)):
+            b = bucket_length(len(chunks[k]) or 1, self.length_buckets)
+            by_bucket.setdefault(b, []).append(k)
+
+        def rows_for(pad_to: int) -> int:
+            rows = self.batch_size
+            while rows * pad_to > MAX_BATCH_BYTES and rows > 64:
+                rows //= 2
+            return rows
+
+        plan: list[tuple[np.ndarray, int]] = []
+        carry: list[int] = []
+        for pad_to in sorted(by_bucket):
+            idxs = carry + by_bucket[pad_to]
+            rows = rows_for(pad_to)
+            full_end = len(idxs) - len(idxs) % rows
+            for start in range(0, full_end, rows):
+                plan.append((np.asarray(idxs[start : start + rows]), pad_to))
+            carry = idxs[full_end:]
+        if carry:
+            pad_to = bucket_length(
+                max(len(chunks[k]) for k in carry) or 1, self.length_buckets
+            )
+            rows = rows_for(pad_to)
+            for start in range(0, len(carry), rows):
+                plan.append((np.asarray(carry[start : start + rows]), pad_to))
         pending: list[tuple[np.ndarray, object]] = []
         with self.metrics.timer("score_s"):
-            for start in range(0, len(order), self.batch_size):
-                sel = order[start : start + self.batch_size]
+            for sel, pad_to in plan:
                 batch_docs = [chunks[k] for k in sel]
                 batch_limits = [limits[k] for k in sel]
                 if self.mesh is not None:
@@ -334,10 +372,6 @@ class BatchRunner:
                         self._ndata,
                         (batch_limits, self.max_chunk),
                     )
-                pad_to = bucket_length(
-                    max((len(d) for d in batch_docs), default=1),
-                    self.length_buckets,
-                )
                 batch, lengths = self._pack(batch_docs, pad_to)
                 # Batches without chunked docs (the common case) skip the
                 # window-limit array entirely — one fewer host→device
@@ -405,24 +439,22 @@ class BatchRunner:
                 pending.append((sel, scores))
                 self.metrics.incr("chunks_scored", len(sel))
 
-            # ONE device→host fetch for the whole call: per-batch fetches
-            # each pay the device-sync latency (measured ~8ms/batch over a
-            # tunneled TPU, dwarfing the ~1ms compute), so the per-batch
-            # results are concatenated on device and pulled in a single
-            # transfer instead.
-            if len(pending) > 1:
-                all_scores = jnp.concatenate([s for _, s in pending], axis=0)
-            else:
-                all_scores = pending[0][1]
-            all_host = np.asarray(all_scores)
+            # Results stream back asynchronously: each batch's d2h copy is
+            # started as soon as every batch is dispatched (payloads are tiny
+            # — [B, L] floats — it's all latency), so result transfer overlaps
+            # the remaining compute instead of serializing after it. A
+            # blocking per-batch np.asarray here would instead pay the full
+            # device-sync latency once per batch (measured ~8ms over a
+            # tunneled TPU).
+            for _, s in pending:
+                try:
+                    s.copy_to_host_async()
+                except AttributeError:  # non-jax array (numpy test doubles)
+                    pass
             doc_idx_arr = np.asarray(doc_idx, dtype=np.int64)
-            offset = 0
             for sel, s in pending:
                 # Rows beyond len(sel) are mesh pad rows — dropped here.
-                np.add.at(
-                    out, doc_idx_arr[sel], all_host[offset : offset + len(sel)]
-                )
-                offset += s.shape[0]
+                np.add.at(out, doc_idx_arr[sel], np.asarray(s)[: len(sel)])
 
         self.metrics.incr("docs_scored", N)
         log_event(
@@ -430,7 +462,7 @@ class BatchRunner:
             "runner.score",
             docs=N,
             chunks=len(chunks),
-            batches=-(-len(chunks) // self.batch_size),
+            batches=len(plan),
         )
         return out
 

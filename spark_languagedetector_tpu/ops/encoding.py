@@ -59,7 +59,14 @@ def bucket_length(length: int, buckets: Sequence[int]) -> int:
     return width
 
 
-DEFAULT_LENGTH_BUCKETS: tuple[int, ...] = (128, 512, 2048, 8192)
+# ~1.5× growth bounds padding waste at 50% worst-case (the old 4×-growth set
+# paid up to 4× transfer + compute on docs just past a bucket edge); all
+# values are multiples of 128 so Mosaic lane tiling never re-pads short
+# buckets. More buckets = more compiled shapes, but only shapes actually seen
+# compile, and each is cached for the process lifetime.
+DEFAULT_LENGTH_BUCKETS: tuple[int, ...] = (
+    128, 256, 512, 768, 1024, 1536, 2048, 3072, 4096, 6144, 8192
+)
 
 
 def pad_batch(

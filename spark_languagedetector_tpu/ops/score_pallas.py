@@ -51,9 +51,12 @@ from .vocab import EXACT, VocabSpec
 # Documents per grid step: the sublane tile height of the batch block.
 DB = 8
 
-# Window-axis block (lane dimension of the one-hots). 512 divides every
-# default length bucket except 128 (handled by shrinking to the padded S).
-DEFAULT_BLOCK = 512
+# Window-axis block (lane dimension of the one-hots). Larger blocks mean a
+# deeper MXU contraction (K = block) and fewer scratch read-modify-writes;
+# 2048 measured ~30% faster than 512 on v5e for [4096, 2048] batches. Padded
+# widths below the block shrink it to the (128-aligned) width, so short
+# length buckets still run single-step.
+DEFAULT_BLOCK = 2048
 
 # VMEM budget cap: the bigram weight view is L * 256KB resident per dispatch.
 MAX_PALLAS_LANGS = 16
@@ -104,25 +107,34 @@ def _build_kernel(S: int, L: int, blk: int, has1: bool, has2: bool):
                 acc1_ref[:, :] = jnp.zeros((256, 128), jnp.float32)
             for k in range(n_steps):
                 off = k * blk
-                vals = b0_ref[pl.dslice(d, 1), pl.dslice(off, blk)]  # [1, blk]
-                iota = jax.lax.broadcasted_iota(jnp.int32, (256, blk), 0)
-                starts = jax.lax.broadcasted_iota(jnp.int32, (1, blk), 1) + off
-                lim_ok = starts < dlim
-                if has2:
-                    nxt = b1_ref[pl.dslice(d, 1), pl.dslice(off, blk)]
-                    mask2 = (starts <= dlen - 2) & lim_ok
-                    oh0 = jnp.where(
-                        (vals == iota) & mask2, 1.0, 0.0
-                    ).astype(jnp.bfloat16)
-                    oh1 = jnp.where(nxt == iota, 1.0, 0.0).astype(jnp.bfloat16)
-                    acc2_ref[:, :] += jax.lax.dot_general(
-                        oh0, oh1, (((1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.float32,
-                    )
-                if has1:
-                    mask1 = (starts <= dlen - 1) & lim_ok
-                    ohu = jnp.where((vals == iota) & mask1, 1.0, 0.0)
-                    acc1_ref[:, 0:1] += ohu.sum(axis=1, keepdims=True)
+
+                def step(off=off):
+                    vals = b0_ref[pl.dslice(d, 1), pl.dslice(off, blk)]  # [1, blk]
+                    iota = jax.lax.broadcasted_iota(jnp.int32, (256, blk), 0)
+                    starts = jax.lax.broadcasted_iota(jnp.int32, (1, blk), 1) + off
+                    lim_ok = starts < dlim
+                    if has2:
+                        nxt = b1_ref[pl.dslice(d, 1), pl.dslice(off, blk)]
+                        mask2 = (starts <= dlen - 2) & lim_ok
+                        oh0 = jnp.where(
+                            (vals == iota) & mask2, 1.0, 0.0
+                        ).astype(jnp.bfloat16)
+                        oh1 = jnp.where(nxt == iota, 1.0, 0.0).astype(jnp.bfloat16)
+                        acc2_ref[:, :] += jax.lax.dot_general(
+                            oh0, oh1, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                        )
+                    if has1:
+                        mask1 = (starts <= dlen - 1) & lim_ok
+                        ohu = jnp.where((vals == iota) & mask1, 1.0, 0.0)
+                        acc1_ref[:, 0:1] += ohu.sum(axis=1, keepdims=True)
+
+                # A block holds no windows when the doc (or its owned chunk
+                # range) ends before it — skip the one-hot build and matmul
+                # entirely. Skipped blocks leave the pre-zeroed accumulators
+                # intact, so empty docs (and mesh pad rows) correctly score
+                # zero without paying for a single block.
+                pl.when((off < dlen) & (off < dlim))(step)
             for l in range(L):
                 s = jnp.zeros((1, 1), jnp.float32)
                 if has2:

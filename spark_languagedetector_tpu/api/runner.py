@@ -46,6 +46,11 @@ DEFAULT_BATCH_SIZE = 256
 # O(B·vocab) HBM buffers), so its sweet spot is much larger micro-batches —
 # fewer dispatches amortize the per-call host/tunnel overhead.
 DEFAULT_PALLAS_BATCH_SIZE = 4096
+# Hybrid strategy micro-batches: the pallas histogram part wants the same
+# large batches as the pure pallas strategy (measured 2.2× over gather at
+# 4096 rows vs 1.2× at 1024); the n ≥ 3 gather's scan block is capped at
+# 256 windows so its [B, block, L] buffer stays bounded (~1.4GB at L=176).
+DEFAULT_HYBRID_BATCH_SIZE = 4096
 # Hard cap on a single micro-batch's padded bytes. Once a program has
 # executed, h2d transfers ride the real device link (a tunneled relay here:
 # ~30-90MB/s, bursty; pre-execution puts only stage locally and measure
@@ -154,27 +159,34 @@ class BatchRunner:
             self.weights = jax.device_put(self.weights, self.device)
             if self.lut is not None:
                 self.lut = jax.device_put(self.lut, self.device)
-        if self.strategy not in ("auto", "gather", "onehot", "pallas"):
+        if self.strategy not in ("auto", "gather", "onehot", "pallas", "hybrid"):
             raise ValueError(
-                f"unknown strategy {self.strategy!r}; "
-                "expected 'auto', 'gather', 'onehot', or 'pallas'"
+                f"unknown strategy {self.strategy!r}; expected 'auto', "
+                "'gather', 'onehot', 'pallas', or 'hybrid'"
             )
         pallas_ok = self.lut is None and score_pallas.pallas_supported(
             self.spec, self.weights.shape[0], self.weights.shape[1]
         )
+        hybrid_ok = self._hybrid_supported()
         if self.strategy == "auto":
-            # Fused pallas kernel on real accelerators when the vocab
-            # qualifies (exact grams ⊆ {1,2}, dense table, few languages);
-            # one-hot MXU via XLA otherwise-qualifying on CPU (pallas
-            # interpret mode is far too slow outside tests); gather fallback.
-            # On a mesh the XLA strategies partition via GSPMD and the pallas
-            # kernel runs per-shard under shard_map — all three qualify.
+            # Fused/histogram pallas kernel on real accelerators when the
+            # whole vocab qualifies (exact grams ⊆ {1,2}, dense table);
+            # hybrid (pallas for n ≤ 2 + gather for n ≥ 3) when an exact
+            # vocab has longer grams — the short lengths carry most of the
+            # window count, and moving them off the gather path measured
+            # ~2.8× on the 50-language n=1..3 config; one-hot MXU via XLA
+            # otherwise-qualifying on CPU (pallas interpret mode is far too
+            # slow outside tests); gather fallback. On a mesh the XLA
+            # strategies partition via GSPMD and the pallas kernel runs
+            # per-shard under shard_map — all strategies qualify.
             if self.mesh is not None:
                 target = list(self.mesh.devices.flat)[0]
             else:
                 target = self.device or jax.devices()[0]
             if pallas_ok and target.platform == "tpu":
                 self.strategy = "pallas"
+            elif hybrid_ok and target.platform == "tpu":
+                self.strategy = "hybrid"
             elif self.lut is None and score_ops.onehot_supported(
                 self.spec, self.weights.shape[0]
             ):
@@ -191,15 +203,20 @@ class BatchRunner:
         if self.strategy == "pallas" and not pallas_ok:
             raise ValueError(
                 "strategy='pallas' needs an exact vocab with gram lengths "
-                "<= 2, the dense weight table, and at most "
-                f"{score_pallas.MAX_PALLAS_LANGS} languages"
+                "<= 2 and the dense weight table"
+            )
+        if self.strategy == "hybrid" and not hybrid_ok:
+            raise ValueError(
+                "strategy='hybrid' needs exact short-gram ids (exact vocab or "
+                "hashed 'exact12' scheme) with gram lengths both <= 2 and > 2"
             )
         if self.batch_size is None:
-            self.batch_size = (
-                DEFAULT_PALLAS_BATCH_SIZE
-                if self.strategy == "pallas"
-                else DEFAULT_BATCH_SIZE
-            )
+            if self.strategy == "pallas":
+                self.batch_size = DEFAULT_PALLAS_BATCH_SIZE
+            elif self.strategy == "hybrid":
+                self.batch_size = DEFAULT_HYBRID_BATCH_SIZE
+            else:
+                self.batch_size = DEFAULT_BATCH_SIZE
         # Trigger the one-time native-library build here, not inside the
         # first score() call's timed hot loop.
         from .. import native
@@ -209,6 +226,62 @@ class BatchRunner:
     @property
     def max_chunk(self) -> int:
         return self.length_buckets[-1]
+
+    def _hybrid_supported(self) -> bool:
+        """Vocab with both short (≤ 2) and long (> 2) gram lengths whose
+        short-gram ids are exact polynomial ids: the short lengths score
+        through the pallas histogram kernel over a dense sub-table, the long
+        ones through the gather path. True for exact vocabs and for hashed
+        vocabs under the ``exact12`` scheme (whose buckets [0, 65792) are
+        exactly the short-gram polynomial ids)."""
+        from ..ops.vocab import EXACT, EXACT12, HASHED
+
+        glens = self.spec.gram_lengths
+        ids_exact12 = self.spec.mode == EXACT or (
+            self.spec.mode == HASHED and self.spec.hash_scheme == EXACT12
+        )
+        return (
+            ids_exact12
+            and any(n <= 2 for n in glens)
+            and any(n > 2 for n in glens)
+        )
+
+    def _hybrid_state(self):
+        """(interpret, spec12, w1, w2, rest_lengths) for the hybrid strategy.
+
+        The dense n ≤ 2 sub-table is materialized once from the profile
+        (via the LUT for compact profiles — exact n ≥ 3 id spaces are far
+        too large for a dense table, so ``lut`` is the expected form). The
+        sub-spec's id layout matches the full exact spec's first rows
+        (1-gram ids, then 2-gram ids — ``exact_offsets`` stacks lengths
+        ascending), so slicing is exact.
+        """
+        state = getattr(self, "_hybrid_cache", None)
+        if state is None:
+            if not self._hybrid_supported():
+                raise ValueError(
+                    "strategy='hybrid' needs exact short-gram ids (exact vocab "
+                    "or hashed 'exact12' scheme) with gram lengths both "
+                    "<= 2 and > 2"
+                )
+            from ..ops.vocab import EXACT, VocabSpec
+
+            sub = tuple(n for n in self.spec.gram_lengths if n <= 2)
+            rest = tuple(n for n in self.spec.gram_lengths if n > 2)
+            spec12 = VocabSpec(EXACT, sub)
+            V12 = spec12.id_space_size
+            if self.lut is not None:
+                dense12 = jnp.asarray(self.weights)[jnp.asarray(self.lut)[:V12]]
+            else:
+                dense12 = jnp.asarray(self.weights)[:V12]
+            w1, w2 = score_pallas.weight_views(dense12, spec12)
+            target = self.device or jax.devices()[0]
+            interpret = target.platform != "tpu"
+            if self.device is not None:
+                w1 = jax.device_put(w1, self.device)
+                w2 = jax.device_put(w2, self.device)
+            state = self._hybrid_cache = (interpret, spec12, w1, w2, rest)
+        return state
 
     def _pallas_state(self):
         """(interpret, w1, w2) for the pallas strategy, built lazily so the
@@ -249,15 +322,20 @@ class BatchRunner:
             )
         return arr
 
-    def _mesh_pallas_fn(self, interpret: bool):
-        """shard_map wrapper running the pallas kernel on each data shard."""
-        fn = getattr(self, "_mesh_pallas_cache", None)
+    def _mesh_pallas_fn(self, interpret: bool, spec=None):
+        """shard_map wrapper running the pallas kernel on each data shard.
+        ``spec`` defaults to the runner's vocab; the hybrid strategy passes
+        its n ≤ 2 sub-spec."""
+        spec = spec or self.spec
+        cache = getattr(self, "_mesh_pallas_cache", None)
+        if cache is None:
+            cache = self._mesh_pallas_cache = {}
+        fn = cache.get((spec, interpret))
         if fn is None:
             from jax.sharding import PartitionSpec as P
 
             from ..parallel.mesh import DATA_AXIS
 
-            spec = self.spec
             block = self.pallas_block or score_pallas.DEFAULT_BLOCK
 
             def local(batch, lengths, w1, w2, lim):
@@ -266,7 +344,7 @@ class BatchRunner:
                     spec=spec, block=block, interpret=interpret,
                 )
 
-            fn = self._mesh_pallas_cache = jax.jit(
+            fn = cache[(spec, interpret)] = jax.jit(
                 jax.shard_map(
                     local,
                     mesh=self.mesh,
@@ -278,6 +356,30 @@ class BatchRunner:
                 )
             )
         return fn
+
+    def _pallas_dispatch(
+        self, batch, lengths, window_limit, placement, interpret, spec, w1, w2
+    ):
+        """Run the pallas scorer on one packed batch — directly on a single
+        device, or per-shard under shard_map on a mesh (pallas_call has no
+        GSPMD partitioning rule; weights replicated, batch split over the
+        data axis)."""
+        if self.mesh is not None:
+            if window_limit is None:
+                window_limit = self._full_limit(batch.shape[0], placement)
+            return self._mesh_pallas_fn(interpret, spec)(
+                batch, lengths, w1, w2, window_limit
+            )
+        return score_pallas.score_batch_pallas(
+            batch,
+            lengths,
+            w1,
+            w2,
+            window_limit,
+            spec=spec,
+            block=self.pallas_block or score_pallas.DEFAULT_BLOCK,
+            interpret=interpret,
+        )
 
     @staticmethod
     def _pack(batch_docs, pad_to: int):
@@ -393,29 +495,29 @@ class BatchRunner:
                     window_limit = jax.device_put(window_limit, placement)
                 if self.strategy == "pallas":
                     interpret, w1, w2 = self._pallas_state()
-                    if self.mesh is not None:
-                        # pallas_call has no GSPMD partitioning rule; run the
-                        # kernel per-shard under shard_map (weights
-                        # replicated, batch split over the data axis).
-                        if window_limit is None:
-                            window_limit = self._full_limit(
-                                batch.shape[0], placement
-                            )
-                        scores = self._mesh_pallas_fn(interpret)(
-                            batch, lengths, w1, w2, window_limit
-                        )
-                    else:
-                        scores = score_pallas.score_batch_pallas(
-                            batch,
-                            lengths,
-                            w1,
-                            w2,
-                            window_limit,
-                            spec=self.spec,
-                            block=self.pallas_block
-                            or score_pallas.DEFAULT_BLOCK,
-                            interpret=interpret,
-                        )
+                    scores = self._pallas_dispatch(
+                        batch, lengths, window_limit, placement,
+                        interpret, self.spec, w1, w2,
+                    )
+                elif self.strategy == "hybrid":
+                    # n ≤ 2 through the pallas histogram kernel over the
+                    # dense sub-table; n ≥ 3 through the gather path. Both
+                    # parts see the same window limits; each handles its own
+                    # lengths' partial-window rules, so the sum is exact.
+                    interpret, spec12, w1, w2, rest = self._hybrid_state()
+                    scores = self._pallas_dispatch(
+                        batch, lengths, window_limit, placement,
+                        interpret, spec12, w1, w2,
+                    ) + score_ops.score_batch(
+                        batch,
+                        lengths,
+                        self.weights,
+                        self.lut,
+                        spec=self.spec,
+                        block=min(self.block, 256),
+                        window_limit=window_limit,
+                        gram_lengths_subset=rest,
+                    )
                 elif self.strategy == "onehot":
                     scores = score_ops.score_batch_onehot(
                         batch,

@@ -65,6 +65,14 @@ class _DetectorParams(HasInputCol, HasLabelCol):
         lambda v: v in ("auto", EXACT, HASHED),
     )
     hash_bits = Param("hashBits", "log2 bucket count for hashed vocab", _positive_int)
+    hash_scheme = Param(
+        "hashScheme",
+        "hashed bucket scheme: 'auto' (exact12 when hashBits >= 17), "
+        "'exact12' (grams <= 2 bytes keep collision-free polynomial ids; "
+        "longer grams FNV-fold into the remaining buckets — enables the "
+        "pallas histogram fast path), or 'fnv1a' (all lengths FNV-folded)",
+        lambda v: v in ("auto", "fnv1a", "exact12"),
+    )
     weight_mode = Param(
         "weightMode",
         "'parity': reference formula log(1+presence/#langs) (SURVEY.md Q1); "
@@ -105,6 +113,7 @@ class LanguageDetector(_DetectorParams):
             saveGrams=None,
             vocabMode="auto",
             hashBits=20,
+            hashScheme="auto",
             weightMode=fit_ops.PARITY,
             trainEncoding=UTF8,
             fitBackend="cpu",
@@ -126,6 +135,9 @@ class LanguageDetector(_DetectorParams):
     def set_hash_bits(self, bits: int):
         return self.set("hashBits", bits)
 
+    def set_hash_scheme(self, scheme: str):
+        return self.set("hashScheme", scheme)
+
     def set_weight_mode(self, mode: str):
         return self.set("weightMode", mode)
 
@@ -139,7 +151,12 @@ class LanguageDetector(_DetectorParams):
         mode = self.get("vocabMode")
         if mode == "auto":
             mode = EXACT if max(gram_lengths) <= MAX_EXACT_GRAM_LEN else HASHED
-        return VocabSpec(mode, gram_lengths, hash_bits=self.get("hashBits"))
+        return VocabSpec(
+            mode,
+            gram_lengths,
+            hash_bits=self.get("hashBits"),
+            hash_scheme=self.get("hashScheme"),
+        )
 
     def fit(self, dataset: Table) -> "LanguageDetectorModel":
         label_col, input_col = self.get_label_col(), self.get_input_col()

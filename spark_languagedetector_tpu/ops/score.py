@@ -86,7 +86,7 @@ def _block_accumulate(
     return acc
 
 
-@partial(jax.jit, static_argnames=("spec", "block"))
+@partial(jax.jit, static_argnames=("spec", "block", "gram_lengths_subset"))
 def score_batch(
     batch: jnp.ndarray,
     lengths: jnp.ndarray,
@@ -96,6 +96,7 @@ def score_batch(
     spec: VocabSpec,
     block: int = DEFAULT_BLOCK,
     window_limit: jnp.ndarray | None = None,
+    gram_lengths_subset: tuple[int, ...] | None = None,
 ) -> jnp.ndarray:
     """Scores for a padded batch (gather strategies).
 
@@ -113,6 +114,10 @@ def score_batch(
         < window_limit[i]. Used for long-document chunking: a non-final chunk
         owns starts [0, chunk_size - overlap); the final chunk owns all
         (see ``ops.encoding.chunk_document``). None ⇒ no limit.
+      gram_lengths_subset: optional subset of ``spec.gram_lengths`` to score
+        (ids/partial-window rules unchanged — shorter-length id spaces stay
+        addressable). The hybrid strategy scores n ≤ 2 through the pallas
+        histogram kernel and passes the remaining lengths here.
 
     Returns:
       float32 [B, L] accumulated per-language scores.
@@ -125,7 +130,11 @@ def score_batch(
     # the mask multiply inside the block scan, so any in-range row is safe.
     miss_row = weights.shape[0] - 1 if lut is not None else 0
     total = jnp.zeros((B, L), dtype=jnp.float32)
-    for n in spec.gram_lengths:
+    lengths_to_score = (
+        gram_lengths_subset if gram_lengths_subset is not None
+        else spec.gram_lengths
+    )
+    for n in lengths_to_score:
         W = max(S - n + 1, 1)
         ids = window_ids(batch, n, spec)  # [B, W]
         rows = ids if lut is None else lut[ids]

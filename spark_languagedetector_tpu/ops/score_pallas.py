@@ -58,37 +58,55 @@ DB = 8
 # length buckets still run single-step.
 DEFAULT_BLOCK = 2048
 
-# VMEM budget cap: the bigram weight view is L * 256KB resident per dispatch.
+# Language-count ceiling for the *fused* kernel: its bigram weight view is
+# L × 256KB resident in VMEM per dispatch and its contraction loop is
+# per-language. Larger L switches to the histogram kernel + XLA matmul
+# (``weight_views`` picks the shape; ``score_batch_pallas`` dispatches on it)
+# — per-doc [256, 256] histograms written to HBM, then one MXU contraction
+# ``hist @ W`` over all languages at once, so L is unbounded.
 MAX_PALLAS_LANGS = 16
 
 
 def pallas_supported(spec: VocabSpec, num_rows: int, num_langs: int) -> bool:
-    """True when this kernel applies: exact vocab, gram lengths ⊆ {1, 2},
-    dense weight table over the full id space, small language count."""
+    """True when a pallas strategy applies: exact vocab, gram lengths ⊆
+    {1, 2}, dense weight table over the full id space (any language count —
+    small L runs the fused kernel, large L the histogram kernel)."""
+    if num_langs > MAX_PALLAS_LANGS and 2 not in spec.gram_lengths:
+        # Unigram-only vocabs beyond the fused kernel's L cap would pay for
+        # full [256, 256] histograms just to row-sum them — the XLA one-hot
+        # strategy handles that case with a [B, 256] histogram directly.
+        return False
     return (
         spec.mode == EXACT
         and max(spec.gram_lengths) <= 2
         and num_rows == spec.id_space_size
-        and num_langs <= MAX_PALLAS_LANGS
     )
 
 
 def weight_views(
     weights: np.ndarray | jnp.ndarray, spec: VocabSpec
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Dense [V, L] table → kernel views: w1 [256, L], w2 [L, 256, 256].
+    """Dense [V, L] table → kernel views: w1 [256, L] plus the bigram view.
 
-    Call once per profile (the reshape/transpose is a real relayout — don't
-    re-do it per batch). For gram_lengths == (1,) the bigram view is zeros.
+    For L ≤ MAX_PALLAS_LANGS the bigram view is [L, 256, 256] (VMEM-resident
+    operand of the fused kernel); for larger L it stays [65536, L] (operand
+    of the post-histogram XLA matmul). Call once per profile (the reshape/
+    transpose is a real relayout — don't re-do it per batch). For
+    gram_lengths == (1,) the bigram view is zeros.
     """
     w = jnp.asarray(weights, dtype=jnp.float32)
     L = w.shape[1]
     w1 = w[:256]
+    fused = L <= MAX_PALLAS_LANGS
     if 2 in spec.gram_lengths:
         off = spec.offsets[2]
-        w2 = w[off : off + 65536].reshape(256, 256, L).transpose(2, 0, 1)
-    else:
+        w2 = w[off : off + 65536]
+        if fused:
+            w2 = w2.reshape(256, 256, L).transpose(2, 0, 1)
+    elif fused:
         w2 = jnp.zeros((L, 256, 256), dtype=jnp.float32)
+    else:
+        w2 = jnp.zeros((65536, L), dtype=jnp.float32)
     return w1, w2
 
 
@@ -150,6 +168,127 @@ def _build_kernel(S: int, L: int, blk: int, has1: bool, has2: bool):
     return kernel
 
 
+def _build_hist_kernel(S: int, blk: int, mask_n: int):
+    """Per-document bigram-pair histogram kernel: out[d] = Σ_w oh(b0_w)ᵀ oh(b1_w)
+    over windows with start ≤ dlen - mask_n (and < dlim). With mask_n == 2
+    the [256, 256] histogram counts full bigrams; with mask_n == 1 (unigram-
+    only vocabs) each masked window still contributes exactly one count to
+    row b0_w (oh(b1) sums to 1 per window), so a row-sum recovers the
+    unigram histogram."""
+    n_steps = S // blk
+
+    def kernel(b0_ref, b1_ref, len_ref, lim_ref, o_ref, acc_ref):
+        base = pl.program_id(0) * DB
+        for d in range(DB):
+            dlen = len_ref[base + d]
+            dlim = lim_ref[base + d]
+            acc_ref[:, :] = jnp.zeros((256, 256), jnp.float32)
+            for k in range(n_steps):
+                off = k * blk
+
+                def step(off=off):
+                    vals = b0_ref[pl.dslice(d, 1), pl.dslice(off, blk)]
+                    nxt = b1_ref[pl.dslice(d, 1), pl.dslice(off, blk)]
+                    iota = jax.lax.broadcasted_iota(jnp.int32, (256, blk), 0)
+                    starts = (
+                        jax.lax.broadcasted_iota(jnp.int32, (1, blk), 1) + off
+                    )
+                    mask = (starts <= dlen - mask_n) & (starts < dlim)
+                    oh0 = jnp.where(
+                        (vals == iota) & mask, 1.0, 0.0
+                    ).astype(jnp.bfloat16)
+                    oh1 = jnp.where(nxt == iota, 1.0, 0.0).astype(jnp.bfloat16)
+                    acc_ref[:, :] += jax.lax.dot_general(
+                        oh0, oh1, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+
+                pl.when((off < dlen) & (off < dlim))(step)
+            o_ref[pl.dslice(d * 256, 256), :] = acc_ref[:, :]
+
+    return kernel
+
+
+def _hist_batch(
+    b0: jnp.ndarray,
+    b1: jnp.ndarray,
+    lengths: jnp.ndarray,
+    lim: jnp.ndarray,
+    *,
+    blk: int,
+    mask_n: int,
+    interpret: bool,
+) -> jnp.ndarray:
+    """float32 [B, 256, 256] per-document histograms via the pallas kernel."""
+    B, S = b0.shape
+    out = pl.pallas_call(
+        _build_hist_kernel(S, blk, mask_n),
+        grid=(B // DB,),
+        in_specs=[
+            pl.BlockSpec((DB, S), lambda b: (b, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((DB, S), lambda b: (b, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (DB * 256, 256), lambda b: (b, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * 256, 256), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((256, 256), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)
+        ),
+        interpret=interpret,
+    )(b0, b1, lengths, lim)
+    return out.reshape(B, 256, 256)
+
+
+def _score_from_hist(
+    hist: jnp.ndarray,
+    batch_i32: jnp.ndarray,
+    lengths: jnp.ndarray,
+    lim: jnp.ndarray,
+    w1: jnp.ndarray,
+    w2: jnp.ndarray,
+    has1: bool,
+    has2: bool,
+) -> jnp.ndarray:
+    """Histogram → scores: one MXU contraction over all languages.
+
+    HIGHEST matmul precision keeps the count × log-weight products exact
+    enough for argmax parity with the float64 host scorer (counts are exact
+    integers in f32; bf16 passes would round them past 256).
+    """
+    B = hist.shape[0]
+    scores = jnp.zeros((B, w1.shape[1]), jnp.float32)
+    if has2:
+        scores = scores + jax.lax.dot(
+            hist.reshape(B, 65536), w2,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+    if has1:
+        # Unigram histogram = bigram row-sum + the last byte's n=1 window
+        # (start dlen-1 passes the n=1 mask but not the bigram mask), when
+        # that start is owned by this chunk.
+        h1 = hist.sum(axis=2)
+        if has2:
+            last = batch_i32[
+                jnp.arange(B), jnp.clip(lengths - 1, 0, batch_i32.shape[1] - 1)
+            ]
+            ok = (lengths >= 1) & (lengths - 1 < lim)
+            h1 = h1 + jnp.where(
+                ok[:, None],
+                (last[:, None] == jnp.arange(256, dtype=jnp.int32)).astype(
+                    jnp.float32
+                ),
+                0.0,
+            )
+        scores = scores + jax.lax.dot(
+            h1, w1, precision=jax.lax.Precision.HIGHEST
+        )
+    return scores
+
+
 @partial(jax.jit, static_argnames=("spec", "block", "interpret"))
 def score_batch_pallas(
     batch: jnp.ndarray,
@@ -195,6 +334,23 @@ def score_batch_pallas(
         if window_limit is None
         else window_limit.astype(jnp.int32)
     )
+
+    if w2.ndim == 2:
+        # Histogram path (L > MAX_PALLAS_LANGS): per-doc [256, 256]
+        # histograms from the kernel, then one XLA MXU contraction over all
+        # languages — hist @ W, the north star's matmul, with unbounded L.
+        hist = _hist_batch(
+            b0, b1, lengths.astype(jnp.int32), lim,
+            blk=blk, mask_n=2 if has2 else 1, interpret=interpret,
+        )
+        out = _score_from_hist(
+            hist, b0, lengths.astype(jnp.int32), lim, w1, w2, has1, has2
+        )
+        if has2:
+            out = out + jnp.where(
+                (lengths == 1)[:, None], w1[b0[:, 0]], 0.0
+            )
+        return out[:B0]
 
     out = pl.pallas_call(
         _build_kernel(S, L, blk, has1, has2),

@@ -14,9 +14,19 @@ integers:
   (id space must fit int32 for TPU-friendly integer ops); longer grams use
   hashed mode, matching BASELINE's configs (exact n≤3, hashed n=1..5).
 
-- **HASHED** mode (fastText-lid-style): FNV-1a over the window bytes folded
-  into ``2**bits`` buckets. Collisions merge grams (accuracy impact measured
-  by the parity benchmarks, not assumed); scale is unbounded.
+- **HASHED** mode (fastText-lid-style): window bytes folded into ``2**bits``
+  buckets. Collisions merge grams (accuracy impact measured by the parity
+  benchmarks, not assumed); scale is unbounded. Two bucket schemes:
+
+  * ``exact12`` (default for ``hash_bits >= 17``): grams of length ≤ 2 keep
+    their exact polynomial ids in ``[0, 65792)`` — collision-free — and only
+    grams of length ≥ 3 FNV-fold into the remaining buckets. Short grams are
+    the bulk of the window count, so this both removes their collisions and
+    lets the pallas histogram kernel score them without gathers (the hybrid
+    strategy in ``api.runner``).
+  * ``fnv1a``: FNV-1a over all lengths into the full bucket range — the
+    scheme of models persisted before ``exact12`` existed; the loader
+    defaults to it when metadata carries no scheme.
 
 All id arithmetic is vectorized numpy on host and jnp on device; the two
 implementations are kept in lockstep by tests.
@@ -33,7 +43,15 @@ import numpy as np
 EXACT = "exact"
 HASHED = "hashed"
 
+# Hashed-mode bucket schemes (VocabSpec.hash_scheme).
+FNV1A = "fnv1a"
+EXACT12 = "exact12"
+
 MAX_EXACT_GRAM_LEN = 3
+
+# exact12: grams of length <= 2 own buckets [0, _EXACT12_BASE); longer grams
+# fold into the rest.
+_EXACT12_BASE = 256 + 65536
 
 # FNV-1a 32-bit constants.
 _FNV_OFFSET = np.uint32(2166136261)
@@ -61,6 +79,14 @@ def exact_space_size(gram_lengths: Sequence[int]) -> int:
     return sum(256**n for n in range(1, max(gram_lengths) + 1))
 
 
+# Single source of truth for the exact12 short-gram region: its layout IS the
+# exact layout for gram lengths <= 2 (1-grams at offset 0, 2-grams at 256,
+# fold region starting at the combined space size). Every id-computation site
+# (gram_to_id, window_ids, window_ids_numpy, prefix_hashes) reads these.
+_SHORT_GRAM_OFFSETS = exact_offsets((1, 2))
+assert _EXACT12_BASE == exact_space_size((1, 2))
+
+
 @dataclass(frozen=True)
 class VocabSpec:
     """How window bytes become integer gram ids.
@@ -73,6 +99,9 @@ class VocabSpec:
     mode: str
     gram_lengths: tuple[int, ...]
     hash_bits: int = 20
+    # "auto" resolves at construction: exact12 when the bucket space can hold
+    # the collision-free short-gram region (hash_bits >= 17), fnv1a below.
+    hash_scheme: str = "auto"
 
     def __post_init__(self):
         if self.mode not in (EXACT, HASHED):
@@ -88,6 +117,33 @@ class VocabSpec:
             )
         if self.mode == HASHED and not (1 <= self.hash_bits <= 30):
             raise ValueError(f"hash_bits must be in [1, 30], got {self.hash_bits}")
+        if self.hash_scheme not in ("auto", FNV1A, EXACT12):
+            raise ValueError(
+                f"unknown hash scheme {self.hash_scheme!r}; expected 'auto', "
+                f"{FNV1A!r}, or {EXACT12!r}"
+            )
+        if self.mode == EXACT:
+            # Irrelevant for exact vocabs; normalize so spec equality/hashing
+            # never depends on it.
+            object.__setattr__(self, "hash_scheme", FNV1A)
+        elif self.hash_scheme == "auto":
+            object.__setattr__(
+                self,
+                "hash_scheme",
+                EXACT12 if (1 << self.hash_bits) > _EXACT12_BASE else FNV1A,
+            )
+        elif self.hash_scheme == EXACT12 and (1 << self.hash_bits) <= _EXACT12_BASE:
+            raise ValueError(
+                f"hash_scheme='exact12' needs hash_bits >= 17 (bucket space "
+                f"must exceed {_EXACT12_BASE}); got {self.hash_bits}"
+            )
+
+    @property
+    def _fold_modulus(self) -> int:
+        """Bucket count available to FNV-folded (length >= 3) grams."""
+        if self.hash_scheme == EXACT12:
+            return (1 << self.hash_bits) - _EXACT12_BASE
+        return 1 << self.hash_bits
 
     @property
     def id_space_size(self) -> int:
@@ -114,9 +170,16 @@ class VocabSpec:
             for b in gram:
                 value = value * 256 + b
             return self.offsets[n] + value
+        if self.hash_scheme == EXACT12 and 1 <= len(gram) <= 2:
+            value = 0
+            for b in gram:
+                value = value * 256 + b
+            return _SHORT_GRAM_OFFSETS[len(gram)] + value
         h = int(_FNV_OFFSET)
         for b in gram:
             h = ((h ^ b) * int(_FNV_PRIME)) & 0xFFFFFFFF
+        if self.hash_scheme == EXACT12:
+            return _EXACT12_BASE + h % self._fold_modulus
         return h & ((1 << self.hash_bits) - 1)
 
     def id_to_gram(self, gram_id: int) -> bytes:
@@ -149,14 +212,17 @@ def window_ids_numpy(batch: np.ndarray, n: int, spec: VocabSpec) -> np.ndarray:
         batch = np.pad(batch, ((0, 0), (0, n - S)))
         S = n
     W = S - n + 1
-    if spec.mode == EXACT:
+    if spec.mode == EXACT or (spec.hash_scheme == EXACT12 and n <= 2):
         ids = np.zeros((B, W), dtype=np.int64)
         for i in range(n):
             ids = ids * 256 + batch[:, i : i + W].astype(np.int64)
-        return ids + spec.offsets[n]
+        off = spec.offsets[n] if spec.mode == EXACT else _SHORT_GRAM_OFFSETS[n]
+        return ids + off
     h = np.full((B, W), _FNV_OFFSET, dtype=np.uint32)
     for i in range(n):
         h = (h ^ batch[:, i : i + W].astype(np.uint32)) * _FNV_PRIME
+    if spec.hash_scheme == EXACT12:
+        return (h % np.uint32(spec._fold_modulus)).astype(np.int64) + _EXACT12_BASE
     return (h & np.uint32((1 << spec.hash_bits) - 1)).astype(np.int64)
 
 
@@ -173,33 +239,51 @@ def window_ids(batch: jnp.ndarray, n: int, spec: VocabSpec) -> jnp.ndarray:
         batch = jnp.pad(batch, ((0, 0), (0, n - S)))
         S = n
     W = S - n + 1
-    if spec.mode == EXACT:
+    if spec.mode == EXACT or (spec.hash_scheme == EXACT12 and n <= 2):
         ids = jnp.zeros((B, W), dtype=jnp.int32)
         for i in range(n):
             ids = ids * 256 + batch[:, i : i + W].astype(jnp.int32)
-        return ids + spec.offsets[n]
+        off = spec.offsets[n] if spec.mode == EXACT else _SHORT_GRAM_OFFSETS[n]
+        return ids + off
     h = jnp.full((B, W), _FNV_OFFSET, dtype=jnp.uint32)
     for i in range(n):
         h = (h ^ batch[:, i : i + W].astype(jnp.uint32)) * _FNV_PRIME
+    if spec.hash_scheme == EXACT12:
+        return (h % jnp.uint32(spec._fold_modulus)).astype(jnp.int32) + _EXACT12_BASE
     return (h & ((1 << spec.hash_bits) - 1)).astype(jnp.int32)
 
 
-def prefix_hashes(batch: jnp.ndarray, max_len: int, hash_bits: int) -> jnp.ndarray:
-    """FNV-1a bucket of batch[:, :k] for k = 1..max_len → int32 [B, max_len+1].
+def prefix_hashes(batch: jnp.ndarray, max_len: int, spec: "VocabSpec") -> jnp.ndarray:
+    """Hashed-mode bucket of the k-byte prefix for k = 1..max_len →
+    int32 [B, max_len+1].
 
-    Column k holds the bucket of the k-byte prefix (column 0 is zeros/unused).
-    Only needed for hashed-mode partial windows, where max_len < max gram
-    length, so this is a handful of vector ops.
+    Column k holds the bucket of the k-byte prefix per the spec's scheme
+    (column 0 is zeros/unused). Only needed for hashed-mode partial windows,
+    where max_len < max gram length, so this is a handful of vector ops.
     """
     B, S = batch.shape
     if S < max_len:
         batch = jnp.pad(batch, ((0, 0), (0, max_len - S)))
     h = jnp.full((B,), _FNV_OFFSET, dtype=jnp.uint32)
     cols = [jnp.zeros((B,), dtype=jnp.int32)]
-    mask = jnp.uint32((1 << hash_bits) - 1)
+    exact12 = spec.hash_scheme == EXACT12
+    mask = jnp.uint32((1 << spec.hash_bits) - 1)
+    fold = jnp.uint32(spec._fold_modulus)
     for i in range(max_len):
         h = (h ^ batch[:, i].astype(jnp.uint32)) * _FNV_PRIME
-        cols.append((h & mask).astype(jnp.int32))
+        k = i + 1
+        if exact12 and k == 1:
+            cols.append(_SHORT_GRAM_OFFSETS[1] + batch[:, 0].astype(jnp.int32))
+        elif exact12 and k == 2:
+            cols.append(
+                _SHORT_GRAM_OFFSETS[2]
+                + batch[:, 0].astype(jnp.int32) * 256
+                + batch[:, 1].astype(jnp.int32)
+            )
+        elif exact12:
+            cols.append((h % fold).astype(jnp.int32) + _EXACT12_BASE)
+        else:
+            cols.append((h & mask).astype(jnp.int32))
     return jnp.stack(cols, axis=1)
 
 
@@ -228,7 +312,7 @@ def partial_window_ids(
         )
         len_c = jnp.clip(lengths, 0, n)
         return off_by_len[len_c] + (window0_ids - offsets[n]) // pow256[n - len_c]
-    prefixes = prefix_hashes(batch, n - 1, spec.hash_bits)
+    prefixes = prefix_hashes(batch, n - 1, spec)
     len_c = jnp.clip(lengths, 0, n - 1)
     return prefixes[jnp.arange(batch.shape[0]), len_c]
 

@@ -78,6 +78,7 @@ def save_model(
             "mode": profile.spec.mode,
             "gramLengths": list(profile.spec.gram_lengths),
             "hashBits": profile.spec.hash_bits,
+            "hashScheme": profile.spec.hash_scheme,
         },
         "languages": list(profile.languages),
     }
@@ -144,7 +145,14 @@ def load_model(path: str | Path) -> tuple[GramProfile, str, dict]:
     )
     vocab_meta = meta.get("vocab", {})
     mode = vocab_meta.get("mode", EXACT)
-    spec = VocabSpec(mode, gram_lengths, hash_bits=vocab_meta.get("hashBits", 20))
+    # Models persisted before bucket schemes existed used pure FNV-1a; the
+    # scheme must round-trip exactly or every hashed id changes meaning.
+    spec = VocabSpec(
+        mode,
+        gram_lengths,
+        hash_bits=vocab_meta.get("hashBits", 20),
+        hash_scheme=vocab_meta.get("hashScheme", "fnv1a"),
+    )
 
     prob = _read_parquet(root / "probabilities")
     weights_rows = prob["probabilities"].to_pylist()

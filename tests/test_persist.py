@@ -71,6 +71,47 @@ def test_roundtrip_hashed_model(tmp_path):
     assert loaded.profile.spec == model.profile.spec
 
 
+def test_roundtrip_hashed_exact12_scheme(tmp_path):
+    train = Table(
+        {
+            "lang": ["de", "en"],
+            "fulltext": ["Dies ist ein deutscher Text schön", "this is very nice"],
+        }
+    )
+    model = (
+        LanguageDetector(["de", "en"], [1, 2, 3, 4], 30)
+        .set_vocab_mode(HASHED)
+        .set_hash_bits(18)
+        .fit(train)
+    )
+    assert model.profile.spec.hash_scheme == "exact12"
+    path = str(tmp_path / "model")
+    model.save(path)
+    loaded = LanguageDetectorModel.load(path)
+    assert loaded.profile.spec == model.profile.spec
+
+
+def test_load_pre_scheme_metadata_defaults_to_fnv1a(tmp_path):
+    """Models persisted before bucket schemes existed must keep FNV ids."""
+    train = Table({"lang": ["de", "en"], "fulltext": ["schön öä", "nice day"]})
+    model = (
+        LanguageDetector(["de", "en"], [1, 2, 3], 30)
+        .set_vocab_mode(HASHED)
+        .set_hash_bits(18)
+        .set_hash_scheme("fnv1a")
+        .fit(train)
+    )
+    path = tmp_path / "model"
+    model.save(str(path))
+    meta_file = path / "metadata" / "part-00000"
+    meta = json.loads(meta_file.read_text())
+    del meta["vocab"]["hashScheme"]  # simulate a pre-scheme artifact
+    meta_file.write_text(json.dumps(meta) + "\n")
+    loaded = LanguageDetectorModel.load(str(path))
+    assert loaded.profile.spec.hash_scheme == "fnv1a"
+    assert loaded.profile.spec == model.profile.spec
+
+
 def test_metadata_layout_and_class_check(tmp_path):
     path = tmp_path / "model"
     model = LanguageDetectorModel.from_gram_map({b"ab": [1.0]}, [2], ["de"])

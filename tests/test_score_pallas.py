@@ -104,10 +104,124 @@ def test_supported_gate():
     assert SP.pallas_supported(VocabSpec(EXACT, (1, 2)), 256 + 65536, 3)
     assert not SP.pallas_supported(VocabSpec(EXACT, (1, 2, 3)), 10, 3)
     assert not SP.pallas_supported(VocabSpec(HASHED, (1, 2)), 1 << 20, 3)
-    # compact (non-dense) table or too many languages
+    # compact (non-dense) table disqualifies; large L does NOT (hist path)
     assert not SP.pallas_supported(VocabSpec(EXACT, (2,)), 100, 3)
-    assert not SP.pallas_supported(
+    assert SP.pallas_supported(
         VocabSpec(EXACT, (2,)), 256 + 65536, SP.MAX_PALLAS_LANGS + 1
+    )
+
+
+@pytest.mark.parametrize("gram_lengths", [(1,), (2,), (1, 2)])
+def test_hist_path_many_languages_matches_oracle(gram_lengths):
+    """L > MAX_PALLAS_LANGS routes through the histogram kernel + matmul."""
+    spec = VocabSpec(EXACT, gram_lengths)
+    rng = np.random.default_rng(23)
+    L = SP.MAX_PALLAS_LANGS + 4
+    weights = rng.normal(size=(spec.id_space_size, L)).astype(np.float32)
+    w1, w2 = SP.weight_views(weights, spec)
+    assert w2.ndim == 2  # the non-fused view
+    docs = [b"", b"a", b"ab", b"hello world"] + _random_docs(rng, 12, 300)
+    got = _pallas_scores(docs, weights, spec, pad_to=384)
+    want = S.score_batch_numpy(docs, weights, None, spec)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
+
+
+def test_hist_path_window_limit_matches_gather():
+    spec = VocabSpec(EXACT, (1, 2))
+    rng = np.random.default_rng(29)
+    L = SP.MAX_PALLAS_LANGS + 4
+    weights = rng.normal(size=(spec.id_space_size, L)).astype(np.float32)
+    docs = _random_docs(rng, 8, 250)
+    docs = [d if len(d) >= 2 else b"ab" for d in docs]
+    batch, lengths = pad_batch(docs, pad_to=256)
+    limit = np.asarray([100, 256, 3, 17, 250, 1, 56, 200], dtype=np.int32)
+    gather = np.asarray(
+        S.score_batch(
+            jnp.asarray(batch), jnp.asarray(lengths), jnp.asarray(weights),
+            None, spec=spec, block=128, window_limit=jnp.asarray(limit),
+        )
+    )
+    got = _pallas_scores(docs, weights, spec, pad_to=256, window_limit=limit)
+    np.testing.assert_allclose(got, gather, rtol=1e-4, atol=1e-3)
+
+
+def test_runner_hybrid_strategy_matches_gather():
+    """hybrid = pallas histogram for n<=2 + gather for n>=3 (exact vocab)."""
+    from spark_languagedetector_tpu.api.runner import BatchRunner
+
+    spec = VocabSpec(EXACT, (1, 2, 3))
+    rng = np.random.default_rng(31)
+    # Compact profile + LUT (the realistic form for exact n=3 id spaces).
+    G = 4000
+    ids = np.sort(rng.choice(spec.id_space_size, G, replace=False))
+    weights = np.zeros((G + 1, 4), np.float32)
+    weights[:G] = rng.normal(size=(G, 4)).astype(np.float32)
+    lut = np.full(spec.id_space_size, G, np.int32)
+    lut[ids] = np.arange(G, dtype=np.int32)
+    docs = _random_docs(rng, 10, 200) + [b"", b"q", b"ab"]
+    hybrid = BatchRunner(
+        weights=jnp.asarray(weights), lut=jnp.asarray(lut), spec=spec,
+        batch_size=8, strategy="hybrid",
+    )
+    gather = BatchRunner(
+        weights=jnp.asarray(weights), lut=jnp.asarray(lut), spec=spec,
+        batch_size=8, strategy="gather",
+    )
+    np.testing.assert_allclose(
+        hybrid.score(docs), gather.score(docs), rtol=1e-4, atol=1e-3
+    )
+
+
+def test_runner_hybrid_hashed_exact12_matches_gather():
+    """exact12 hashed vocab: n<=2 buckets are polynomial ids, so hybrid's
+    pallas sub-table slice is exact for them."""
+    from spark_languagedetector_tpu.api.runner import BatchRunner
+
+    spec = VocabSpec(HASHED, (1, 2, 3, 4), hash_bits=17)
+    assert spec.hash_scheme == "exact12"
+    rng = np.random.default_rng(41)
+    V_ = spec.id_space_size
+    G = 3000
+    ids = np.sort(rng.choice(V_, G, replace=False))
+    weights = np.zeros((G + 1, 5), np.float32)
+    weights[:G] = rng.normal(size=(G, 5)).astype(np.float32)
+    lut = np.full(V_, G, np.int32)
+    lut[ids] = np.arange(G, dtype=np.int32)
+    docs = _random_docs(rng, 10, 200) + [b"", b"q", b"ab"]
+    hybrid = BatchRunner(
+        weights=jnp.asarray(weights), lut=jnp.asarray(lut), spec=spec,
+        batch_size=8, strategy="hybrid",
+    )
+    gather = BatchRunner(
+        weights=jnp.asarray(weights), lut=jnp.asarray(lut), spec=spec,
+        batch_size=8, strategy="gather",
+    )
+    np.testing.assert_allclose(
+        hybrid.score(docs), gather.score(docs), rtol=1e-4, atol=1e-3
+    )
+
+
+def test_runner_hybrid_long_doc_chunking():
+    """Chunked docs exercise window limits through both hybrid parts."""
+    from spark_languagedetector_tpu.api.runner import BatchRunner
+
+    spec = VocabSpec(EXACT, (1, 2, 3))
+    rng = np.random.default_rng(37)
+    G = 1000
+    ids = np.sort(rng.choice(spec.id_space_size, G, replace=False))
+    weights = np.zeros((G + 1, 3), np.float32)
+    weights[:G] = rng.normal(size=(G, 3)).astype(np.float32)
+    lut = np.full(spec.id_space_size, G, np.int32)
+    lut[ids] = np.arange(G, dtype=np.int32)
+    docs = [bytes(rng.integers(0, 256, 700, dtype=np.uint8))]
+    kw = dict(
+        weights=jnp.asarray(weights), lut=jnp.asarray(lut), spec=spec,
+        batch_size=8, length_buckets=(128, 256),
+    )
+    hybrid = BatchRunner(strategy="hybrid", **kw)
+    gather = BatchRunner(strategy="gather", **kw)
+    np.testing.assert_allclose(
+        hybrid.score(docs), gather.score(docs), rtol=1e-4, atol=1e-3
     )
 
 

@@ -73,6 +73,45 @@ def test_hashed_window_ids_match_scalar_hash():
     assert ids.tolist() == expected
 
 
+def test_exact12_scheme_short_grams_get_polynomial_ids():
+    spec = V.VocabSpec(V.HASHED, (1, 2, 5), hash_bits=20)
+    assert spec.hash_scheme == V.EXACT12  # auto resolves at >= 17 bits
+    assert spec.gram_to_id(b"\x00") == 0
+    assert spec.gram_to_id(b"\xff") == 255
+    assert spec.gram_to_id(b"ab") == 256 + ord("a") * 256 + ord("b")
+    # long grams fold into [65792, 2^20)
+    gid = spec.gram_to_id(b"hello")
+    assert 256 + 65536 <= gid < (1 << 20)
+
+
+def test_exact12_scheme_window_ids_lockstep():
+    rng = np.random.default_rng(3)
+    batch = rng.integers(0, 256, size=(4, 32), dtype=np.uint8)
+    spec = V.VocabSpec(V.HASHED, (1, 2, 3, 4, 5), hash_bits=20)
+    for n in spec.gram_lengths:
+        host = V.window_ids_numpy(batch, n, spec)
+        dev = np.asarray(V.window_ids(batch, n, spec))
+        np.testing.assert_array_equal(host, dev.astype(np.int64))
+        doc = bytes(batch[0, : n + 3])
+        expected = [spec.gram_to_id(doc[i : i + n]) for i in range(4)]
+        assert host[0, :4].tolist() == expected
+
+
+def test_exact12_auto_falls_back_below_17_bits():
+    spec = V.VocabSpec(V.HASHED, (1, 2, 5), hash_bits=12)
+    assert spec.hash_scheme == V.FNV1A
+    with pytest.raises(ValueError, match="hash_bits >= 17"):
+        V.VocabSpec(V.HASHED, (1, 2, 5), hash_bits=12, hash_scheme="exact12")
+
+
+def test_fnv1a_scheme_still_available():
+    spec = V.VocabSpec(V.HASHED, (1, 2, 5), hash_bits=20, hash_scheme="fnv1a")
+    # pure FNV: a 1-byte gram does NOT get its polynomial id in general
+    h = 2166136261
+    h = ((h ^ ord("a")) * 16777619) & 0xFFFFFFFF
+    assert spec.gram_to_id(b"a") == h & ((1 << 20) - 1)
+
+
 def test_short_doc_ids_one_per_longer_gram_length():
     spec = V.VocabSpec(V.EXACT, (2, 3))
     assert V.short_doc_ids_numpy(b"", spec) == []

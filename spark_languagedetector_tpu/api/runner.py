@@ -142,7 +142,11 @@ class BatchRunner:
     # replicated; GSPMD partitions the jitted scorer across all devices.
     # Mutually exclusive with `device`.
     mesh: object | None = None
-    strategy: str = "auto"  # 'auto' | 'gather' | 'onehot' | 'pallas'
+    strategy: str = "auto"  # 'auto' | 'gather' | 'onehot' | 'pallas' | 'hybrid'
+    # Cuckoo membership (ops.cuckoo.CuckooTable, host arrays) for exact
+    # vocabs with gram lengths > 3 — routed through the gather-style
+    # dispatch with packed-key lookups instead of a LUT.
+    cuckoo: object | None = None
     metrics: Metrics = field(default_factory=Metrics)
 
     def __post_init__(self):
@@ -152,13 +156,18 @@ class BatchRunner:
             from ..parallel.mesh import DATA_AXIS, replicated
 
             self._ndata = int(self.mesh.shape[DATA_AXIS])
-            self.weights = jax.device_put(self.weights, replicated(self.mesh))
+            placement = replicated(self.mesh)
+        else:
+            placement = self.device
+        if placement is not None:
+            self.weights = jax.device_put(self.weights, placement)
             if self.lut is not None:
-                self.lut = jax.device_put(self.lut, replicated(self.mesh))
-        elif self.device is not None:
-            self.weights = jax.device_put(self.weights, self.device)
-            if self.lut is not None:
-                self.lut = jax.device_put(self.lut, self.device)
+                self.lut = jax.device_put(self.lut, placement)
+        if self.cuckoo is not None:
+            entries = jnp.asarray(self.cuckoo.entries())
+            if placement is not None:
+                entries = jax.device_put(entries, placement)
+            self._cuckoo_entries = entries
         if self.strategy not in ("auto", "gather", "onehot", "pallas", "hybrid"):
             raise ValueError(
                 f"unknown strategy {self.strategy!r}; expected 'auto', "
@@ -270,7 +279,25 @@ class BatchRunner:
             rest = tuple(n for n in self.spec.gram_lengths if n > 2)
             spec12 = VocabSpec(EXACT, sub)
             V12 = spec12.id_space_size
-            if self.lut is not None:
+            if self.cuckoo is not None:
+                # Look every short-gram key up in the cuckoo table (host) to
+                # materialize the dense sub-table rows.
+                from ..ops.cuckoo import lookup_numpy
+
+                v1 = np.arange(256, dtype=np.uint32)
+                lo1 = (v1 << 24).astype(np.int32)
+                hi1 = np.full(256, 1 << 8, np.int32)
+                a = np.repeat(np.arange(256, dtype=np.uint32), 256)
+                b = np.tile(np.arange(256, dtype=np.uint32), 256)
+                lo2 = ((a << 24) | (b << 16)).astype(np.int32)
+                hi2 = np.full(65536, 2 << 8, np.int32)
+                rows = lookup_numpy(
+                    self.cuckoo,
+                    np.concatenate([lo1, lo2]),
+                    np.concatenate([hi1, hi2]),
+                )[:V12]
+                dense12 = jnp.asarray(self.weights)[jnp.asarray(rows)]
+            elif self.lut is not None:
                 dense12 = jnp.asarray(self.weights)[jnp.asarray(self.lut)[:V12]]
             else:
                 dense12 = jnp.asarray(self.weights)[:V12]
@@ -356,6 +383,36 @@ class BatchRunner:
                 )
             )
         return fn
+
+    def _gather_scores(
+        self, batch, lengths, window_limit, gram_lengths_subset, *, block
+    ):
+        """Gather-style scoring on one packed batch: LUT/dense ids, or
+        packed-key cuckoo membership when the profile's gram lengths exceed
+        the int32 id space."""
+        if self.cuckoo is not None:
+            return score_ops.score_batch_cuckoo(
+                batch,
+                lengths,
+                self.weights,
+                self._cuckoo_entries,
+                seed1=self.cuckoo.seed1,
+                seed2=self.cuckoo.seed2,
+                spec=self.spec,
+                block=block,
+                window_limit=window_limit,
+                gram_lengths_subset=gram_lengths_subset,
+            )
+        return score_ops.score_batch(
+            batch,
+            lengths,
+            self.weights,
+            self.lut,
+            spec=self.spec,
+            block=block,
+            window_limit=window_limit,
+            gram_lengths_subset=gram_lengths_subset,
+        )
 
     def _pallas_dispatch(
         self, batch, lengths, window_limit, placement, interpret, spec, w1, w2
@@ -508,15 +565,9 @@ class BatchRunner:
                     scores = self._pallas_dispatch(
                         batch, lengths, window_limit, placement,
                         interpret, spec12, w1, w2,
-                    ) + score_ops.score_batch(
-                        batch,
-                        lengths,
-                        self.weights,
-                        self.lut,
-                        spec=self.spec,
+                    ) + self._gather_scores(
+                        batch, lengths, window_limit, rest,
                         block=min(self.block, 256),
-                        window_limit=window_limit,
-                        gram_lengths_subset=rest,
                     )
                 elif self.strategy == "onehot":
                     scores = score_ops.score_batch_onehot(
@@ -528,14 +579,8 @@ class BatchRunner:
                         window_limit=window_limit,
                     )
                 else:
-                    scores = score_ops.score_batch(
-                        batch,
-                        lengths,
-                        self.weights,
-                        self.lut,
-                        spec=self.spec,
-                        block=self.block,
-                        window_limit=window_limit,
+                    scores = self._gather_scores(
+                        batch, lengths, window_limit, None, block=self.block
                     )
                 # Async dispatch: keep packing while the device works.
                 pending.append((sel, scores))

@@ -26,7 +26,13 @@ from ..api.runner import BatchRunner, resolve_device, resolve_mesh
 from ..api.table import STRING, Schema, Table, require_string_column
 from ..ops import fit as fit_ops
 from ..ops.encoding import LOW_BYTE, UTF8, text_to_bytes, texts_to_bytes
-from ..ops.vocab import EXACT, HASHED, MAX_EXACT_GRAM_LEN, VocabSpec
+from ..ops.vocab import (
+    EXACT,
+    HASHED,
+    MAX_DEVICE_ID_GRAM_LEN,
+    MAX_EXACT_GRAM_LEN,
+    VocabSpec,
+)
 from ..utils.logging import get_logger, log_event
 from .profile import GramProfile
 
@@ -150,7 +156,10 @@ class LanguageDetector(_DetectorParams):
         gram_lengths = tuple(self.get("gramLengths"))
         mode = self.get("vocabMode")
         if mode == "auto":
-            mode = EXACT if max(gram_lengths) <= MAX_EXACT_GRAM_LEN else HASHED
+            # Auto prefers the dense/LUT id forms: exact through n = 3 (int32
+            # device ids), hashed beyond. Exact n = 4..5 (cuckoo membership)
+            # is available by explicit vocabMode="exact".
+            mode = EXACT if max(gram_lengths) <= MAX_DEVICE_ID_GRAM_LEN else HASHED
         return VocabSpec(
             mode,
             gram_lengths,
@@ -194,6 +203,15 @@ class LanguageDetector(_DetectorParams):
         docs = texts_to_bytes(texts.tolist(), self.get("trainEncoding"))
         lang_idx = np.asarray([lang_to_idx[l] for l in label_list])
         if self.get("fitBackend") == "device":
+            if (
+                spec.mode == EXACT
+                and max(spec.gram_lengths) > MAX_DEVICE_ID_GRAM_LEN
+            ):
+                raise ValueError(
+                    "fitBackend='device' needs dense device ids (exact gram "
+                    "lengths <= 3 or hashed vocab); exact n=4..5 profiles "
+                    "fit on the host (fitBackend='cpu')"
+                )
             from ..api.runner import resolve_fit_mesh
             from ..ops.fit_tpu import fit_profile_device
 
@@ -344,12 +362,13 @@ class LanguageDetectorModel(HasInputCol, HasOutputCol):
 
     def _get_runner(self) -> BatchRunner:
         if self._runner is None:
-            weights, lut = self.profile.device_arrays()
+            weights, lut, cuckoo = self.profile.device_membership()
             backend = self.get("backend")
             mesh = resolve_mesh(backend)
             self._runner = BatchRunner(
                 weights=weights,
                 lut=lut,
+                cuckoo=cuckoo,
                 spec=self.profile.spec,
                 batch_size=self.get("batchSize"),
                 device=None if mesh is not None else resolve_device(backend),

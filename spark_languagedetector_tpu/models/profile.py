@@ -122,6 +122,17 @@ class GramProfile:
         otherwise an int32 [id_space] id→row table plus compact weights with
         the zeros miss-row appended at row G.
         """
+        from ..ops.vocab import MAX_DEVICE_ID_GRAM_LEN
+
+        if (
+            self.spec.mode == EXACT
+            and max(self.spec.gram_lengths) > MAX_DEVICE_ID_GRAM_LEN
+        ):
+            raise ValueError(
+                "exact gram lengths > 3 have no dense/LUT device form "
+                "(id space exceeds int32); use device_membership(), whose "
+                "cuckoo table handles them"
+            )
         itemsize = jnp.dtype(dtype).itemsize
         L = self.num_languages
         V = self.spec.id_space_size
@@ -148,6 +159,37 @@ class GramProfile:
         lut = np.full(self.spec.id_space_size, G, dtype=np.int32)
         lut[compact.ids] = np.arange(G, dtype=np.int32)
         return jnp.asarray(w, dtype=dtype), jnp.asarray(lut)
+
+    def device_membership(
+        self,
+        dtype=jnp.float32,
+        dense_budget_bytes: int = DENSE_TABLE_BUDGET_BYTES,
+    ):
+        """(weights_dev, lut_dev, cuckoo) — the general device view.
+
+        Exact vocabs with gram lengths > 3 overflow int32 device ids and the
+        LUT over their id space is impossible, so membership ships as a
+        cuckoo table over packed keys (``ops.cuckoo``); everything else
+        returns the :meth:`device_arrays` forms with ``cuckoo=None``.
+        """
+        from ..ops.cuckoo import build_cuckoo
+        from ..ops.vocab import MAX_DEVICE_ID_GRAM_LEN, gram_key
+
+        if (
+            self.spec.mode == EXACT
+            and max(self.spec.gram_lengths) > MAX_DEVICE_ID_GRAM_LEN
+        ):
+            L = self.num_languages
+            keys = [gram_key(self.spec.id_to_gram(int(i))) for i in self.ids]
+            keys_lo = np.asarray([k[0] for k in keys], dtype=np.int32)
+            keys_hi = np.asarray([k[1] for k in keys], dtype=np.int32)
+            table = build_cuckoo(keys_lo, keys_hi)
+            w = np.concatenate(
+                [self.weights, np.zeros((1, L), self.weights.dtype)]
+            )
+            return jnp.asarray(w, dtype=dtype), None, table
+        w, lut = self.device_arrays(dtype, dense_budget_bytes)
+        return w, lut, None
 
     def host_arrays(self) -> tuple[np.ndarray, np.ndarray | None]:
         """(weights, sorted_ids) for ``ops.score.score_batch_numpy``: compact

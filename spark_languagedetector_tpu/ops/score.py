@@ -39,7 +39,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .vocab import EXACT, HASHED, VocabSpec, partial_window_ids, window_ids
+from .vocab import (
+    EXACT,
+    HASHED,
+    VocabSpec,
+    mix32,
+    partial_window_ids,
+    partial_window_keys,
+    window_ids,
+    window_keys,
+)
 
 # Default window-axis block for the scan; multiple of 128 lanes.
 DEFAULT_BLOCK = 1024
@@ -60,6 +69,33 @@ def _partial_window_rows(
     short_ids = partial_window_ids(batch, lengths, n, window0_ids, spec)
     rows = short_ids if lut is None else lut[short_ids]
     return jnp.where(lengths > 0, rows, miss_row)
+
+
+def _splice_partial_windows(
+    rows: jnp.ndarray,
+    partial_rows: jnp.ndarray,
+    lengths: jnp.ndarray,
+    n: int,
+    window_limit: jnp.ndarray | None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Shared window-mask + Scala-``sliding`` partial-window splice.
+
+    Full windows are those with start ≤ len - n (AND start < window_limit
+    when chunk-ownership limits apply); a doc shorter than n contributes its
+    single partial window in column 0 regardless of the limit (chunking
+    never produces short rows, so the limit cannot apply to them). Both the
+    id scorer and the cuckoo scorer resolve rows their own way, then apply
+    exactly this rule — keep it in one place so they cannot drift.
+    """
+    B, W = rows.shape
+    starts = jnp.arange(W, dtype=jnp.int32)[None, :]
+    mask = starts <= (lengths[:, None] - n)
+    if window_limit is not None:
+        mask = mask & (starts < window_limit[:, None])
+    is_short = lengths < n
+    rows = rows.at[:, 0].set(jnp.where(is_short, partial_rows, rows[:, 0]))
+    mask = mask.at[:, 0].set(mask[:, 0] | (is_short & (lengths > 0)))
+    return rows, mask
 
 
 def _block_accumulate(
@@ -135,20 +171,89 @@ def score_batch(
         else spec.gram_lengths
     )
     for n in lengths_to_score:
-        W = max(S - n + 1, 1)
         ids = window_ids(batch, n, spec)  # [B, W]
         rows = ids if lut is None else lut[ids]
-        starts = jnp.arange(W, dtype=jnp.int32)[None, :]
-        mask = starts <= (lengths[:, None] - n)  # full windows only
-        if window_limit is not None:
-            mask = mask & (starts < window_limit[:, None])
-        # Partial-window rule for docs shorter than n (Scala sliding parity).
         partial_rows = _partial_window_rows(
             batch, lengths, n, ids[:, 0], spec, lut, miss_row
         )
-        is_short = lengths < n
-        rows = rows.at[:, 0].set(jnp.where(is_short, partial_rows, rows[:, 0]))
-        mask = mask.at[:, 0].set(mask[:, 0] | (is_short & (lengths > 0)))
+        rows, mask = _splice_partial_windows(
+            rows, partial_rows, lengths, n, window_limit
+        )
+        total = total + _block_accumulate(weights, rows, mask, block)
+    return total
+
+
+# ------------------------------------------------ cuckoo-membership scorer ---
+
+
+def _cuckoo_rows(
+    lo: jnp.ndarray,
+    hi: jnp.ndarray,
+    entries: jnp.ndarray,
+    miss_row: int,
+    seed1: int,
+    seed2: int,
+) -> jnp.ndarray:
+    """Two-probe verified lookup: packed keys → compact weight rows (or the
+    miss row G). ``entries`` is the int32 [M, 4] packed table
+    (``ops.cuckoo.CuckooTable.entries``): each probe is one wide gather
+    carrying key halves + row. M is a power of two, so ``% M`` is a mask."""
+    M = entries.shape[0]
+    h1 = (mix32(lo, hi, seed1, xp=jnp) & jnp.uint32(M - 1)).astype(jnp.int32)
+    h2 = (mix32(lo, hi, seed2, xp=jnp) & jnp.uint32(M - 1)).astype(jnp.int32)
+    e1 = entries[h1]
+    e2 = entries[h2]
+    hit1 = (e1[..., 0] == lo) & (e1[..., 1] == hi)
+    hit2 = (e2[..., 0] == lo) & (e2[..., 1] == hi)
+    return jnp.where(
+        hit1, e1[..., 2], jnp.where(hit2, e2[..., 2], miss_row)
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("seed1", "seed2", "spec", "block", "gram_lengths_subset"),
+)
+def score_batch_cuckoo(
+    batch: jnp.ndarray,
+    lengths: jnp.ndarray,
+    weights: jnp.ndarray,
+    entries: jnp.ndarray,
+    *,
+    seed1: int,
+    seed2: int,
+    spec: VocabSpec,
+    block: int = DEFAULT_BLOCK,
+    window_limit: jnp.ndarray | None = None,
+    gram_lengths_subset: tuple[int, ...] | None = None,
+) -> jnp.ndarray:
+    """Scores via cuckoo membership — exact vocabs whose gram lengths exceed
+    the int32 id space (n = 4..5), where no dense LUT can exist.
+
+    Same contract as :func:`score_batch` (masking, partial-window rule,
+    window_limit, subset), but membership is resolved by packed-key lookup
+    (``ops.cuckoo``) instead of integer ids: per window, two wide gathers
+    into the packed [M, 4] entry table + key verification. ``weights`` is
+    the compact [G+1, L] table with the zeros miss row at G.
+    """
+    assert spec.mode == EXACT
+    B, S = batch.shape
+    L = weights.shape[1]
+    G = weights.shape[0] - 1
+    total = jnp.zeros((B, L), dtype=jnp.float32)
+    lengths_to_score = (
+        gram_lengths_subset if gram_lengths_subset is not None
+        else spec.gram_lengths
+    )
+    for n in lengths_to_score:
+        lo, hi = window_keys(batch, n)
+        rows = _cuckoo_rows(lo, hi, entries, G, seed1, seed2)
+        plo, phi = partial_window_keys(batch, lengths, n)
+        prows = _cuckoo_rows(plo, phi, entries, G, seed1, seed2)
+        prows = jnp.where(lengths > 0, prows, G)
+        rows, mask = _splice_partial_windows(
+            rows, prows, lengths, n, window_limit
+        )
         total = total + _block_accumulate(weights, rows, mask, block)
     return total
 

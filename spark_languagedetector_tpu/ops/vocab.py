@@ -9,10 +9,13 @@ integers:
 - **EXACT** mode (parity): a gram of length n maps bijectively to
   ``offset(n) + poly(bytes)`` where ``poly`` is the big-endian base-256
   polynomial value and ``offset(n)`` stacks the id spaces of the configured
-  gram lengths disjointly. Device-side membership is a binary search over the
-  model's sorted id vector. Exact mode supports ``max(gram_lengths) <= 3``
-  (id space must fit int32 for TPU-friendly integer ops); longer grams use
-  hashed mode, matching BASELINE's configs (exact n≤3, hashed n=1..5).
+  gram lengths disjointly. Device-side membership: lengths ≤ 3 keep int32
+  polynomial ids resolved through a dense table or id→row LUT; lengths 4..5
+  overflow int32 ids, so they resolve through packed ``(lo, hi)`` int32 key
+  pairs and a cuckoo hash table (``ops.cuckoo``) — exact membership in O(1)
+  gathers at any supported length. The cap ``max(gram_lengths) <= 5`` is the
+  packed-key width (4 bytes + fifth byte + length tag in two int32 halves);
+  longer grams use hashed mode.
 
 - **HASHED** mode (fastText-lid-style): window bytes folded into ``2**bits``
   buckets. Collisions merge grams (accuracy impact measured by the parity
@@ -47,7 +50,16 @@ HASHED = "hashed"
 FNV1A = "fnv1a"
 EXACT12 = "exact12"
 
-MAX_EXACT_GRAM_LEN = 3
+# Exact mode supports any gram length up to the packed-key limit. Lengths
+# <= 3 keep int32 polynomial ids on device (dense/LUT membership); lengths
+# 4..5 exceed int32 id space, so the device resolves them with packed
+# (lo, hi) int32 key pairs through a cuckoo hash table (ops/cuckoo.py) —
+# the TPU-native replacement for the reference's JVM byte-sequence map
+# (LanguageDetectorModel.scala:139-152) at any gram length. Host-side ids
+# stay int64 polynomials for every length (fit, persistence, oracle).
+MAX_EXACT_GRAM_LEN = 5
+# Largest gram length device ids (int32 polynomial) can represent.
+MAX_DEVICE_ID_GRAM_LEN = 3
 
 # exact12: grams of length <= 2 own buckets [0, _EXACT12_BASE); longer grams
 # fold into the rest.
@@ -113,7 +125,8 @@ class VocabSpec:
         if self.mode == EXACT and glens[-1] > MAX_EXACT_GRAM_LEN:
             raise ValueError(
                 f"exact vocab supports gram lengths <= {MAX_EXACT_GRAM_LEN} "
-                f"(id space must fit int32); got {glens}. Use mode='hashed'."
+                f"(the packed-key width for device membership); got {glens}. "
+                "Use mode='hashed'."
             )
         if self.mode == HASHED and not (1 <= self.hash_bits <= 30):
             raise ValueError(f"hash_bits must be in [1, 30], got {self.hash_bits}")
@@ -240,6 +253,12 @@ def window_ids(batch: jnp.ndarray, n: int, spec: VocabSpec) -> jnp.ndarray:
         S = n
     W = S - n + 1
     if spec.mode == EXACT or (spec.hash_scheme == EXACT12 and n <= 2):
+        if spec.mode == EXACT and n > MAX_DEVICE_ID_GRAM_LEN:
+            raise ValueError(
+                f"exact {n}-gram ids overflow int32; device membership for "
+                "gram lengths > 3 goes through packed keys (window_keys) "
+                "and the cuckoo scorer"
+            )
         ids = jnp.zeros((B, W), dtype=jnp.int32)
         for i in range(n):
             ids = ids * 256 + batch[:, i : i + W].astype(jnp.int32)
@@ -315,6 +334,117 @@ def partial_window_ids(
     prefixes = prefix_hashes(batch, n - 1, spec)
     len_c = jnp.clip(lengths, 0, n - 1)
     return prefixes[jnp.arange(batch.shape[0]), len_c]
+
+
+# --- packed gram keys (device membership for exact gram lengths > 3) --------
+#
+# A gram of length n <= 5 packs bijectively into two int32 halves:
+#   lo = first four bytes big-endian (missing bytes are zero)
+#   hi = fifth byte | (n << 8)
+# The length tag in ``hi`` keeps different-length prefixes distinct (b"ab\0"
+# vs b"ab"), mirroring the disjoint per-length id spaces of exact mode.
+
+
+def gram_key(gram: bytes) -> tuple[int, int]:
+    """Host scalar: gram bytes (1..5) → (lo, hi) packed key."""
+    n = len(gram)
+    if not 1 <= n <= MAX_EXACT_GRAM_LEN:
+        raise ValueError(f"gram length {n} outside 1..{MAX_EXACT_GRAM_LEN}")
+    lo = 0
+    for i in range(4):
+        lo = (lo << 8) | (gram[i] if i < n else 0)
+    if lo >= 1 << 31:  # match the device's wrapped int32 representation
+        lo -= 1 << 32
+    hi = (gram[4] if n == 5 else 0) | (n << 8)
+    return lo, hi
+
+
+def window_keys(batch: jnp.ndarray, n: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Device window keys: uint8 [B, S] → (lo, hi) int32 [B, S-n+1] each.
+
+    Shifted-slice formulation like :func:`window_ids`; no gathers.
+    """
+    B, S = batch.shape
+    if S < n:
+        batch = jnp.pad(batch, ((0, 0), (0, n - S)))
+        S = n
+    W = S - n + 1
+    lo = jnp.zeros((B, W), dtype=jnp.int32)
+    for i in range(4):
+        plane = (
+            batch[:, i : i + W].astype(jnp.int32)
+            if i < n
+            else jnp.zeros((B, W), jnp.int32)
+        )
+        lo = (lo << 8) | plane
+    hi = (
+        batch[:, 4 : 4 + W].astype(jnp.int32)
+        if n == 5
+        else jnp.zeros((B, W), jnp.int32)
+    ) | (n << 8)
+    return lo, hi
+
+
+def window_keys_numpy(batch: np.ndarray, n: int) -> tuple[np.ndarray, np.ndarray]:
+    """Host mirror of :func:`window_keys` (lockstep-tested)."""
+    B, S = batch.shape
+    if S < n:
+        batch = np.pad(batch, ((0, 0), (0, n - S)))
+        S = n
+    W = S - n + 1
+    lo = np.zeros((B, W), dtype=np.int64)
+    for i in range(4):
+        plane = (
+            batch[:, i : i + W].astype(np.int64)
+            if i < n
+            else np.zeros((B, W), np.int64)
+        )
+        lo = (lo << 8) | plane
+    hi = (
+        batch[:, 4 : 4 + W].astype(np.int64) if n == 5 else np.zeros((B, W), np.int64)
+    ) | (n << 8)
+    return lo.astype(np.int32), hi.astype(np.int32)
+
+
+def partial_window_keys(
+    batch: jnp.ndarray, lengths: jnp.ndarray, n: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Packed key of each doc's single partial window (len < n): (lo, hi) [B].
+
+    The partial window is the whole document, a gram of its own length k
+    (Scala ``sliding`` parity — same rule as :func:`partial_window_ids`).
+    Values are only meaningful where ``0 < lengths < n``; callers mask."""
+    B, S = batch.shape
+    if S < 4:
+        batch = jnp.pad(batch, ((0, 0), (0, 4 - S)))
+    # The partial window's length k <= n - 1 <= 4, so the fifth byte never
+    # participates and hi is just the length tag.
+    k = jnp.clip(lengths, 0, n - 1)
+    lo = jnp.zeros((B,), dtype=jnp.int32)
+    for i in range(4):
+        plane = jnp.where(i < k, batch[:, i].astype(jnp.int32), 0)
+        lo = (lo << 8) | plane
+    hi = k << 8
+    return lo, hi
+
+
+# Murmur3-style 32-bit mixer over a packed key + seed; host and device
+# versions share constants and are lockstep-tested. Used by the cuckoo
+# table's two bucket hashes.
+_MIX_C1 = 0x85EB_CA6B
+_MIX_C2 = 0xC2B2_AE35
+_GOLDEN = 0x9E37_79B9
+
+
+def mix32(lo, hi, seed: int, xp=np):
+    """uint32 mix of int32 (lo, hi) arrays + seed. ``xp``: numpy or jax.numpy.
+    int32 → uint32 casts wrap two's-complement identically in both."""
+    u = xp.uint32
+    h = xp.asarray(lo).astype(u) ^ u((seed * _GOLDEN) & 0xFFFFFFFF)
+    h = (h ^ (h >> u(16))) * u(_MIX_C1)
+    h = h ^ xp.asarray(hi).astype(u) * u(_MIX_C2)
+    h = (h ^ (h >> u(13))) * u(_MIX_C1)
+    return h ^ (h >> u(16))
 
 
 def short_doc_ids_numpy(

@@ -1,0 +1,182 @@
+"""Exact gram membership beyond int32 ids: packed keys + cuckoo table.
+
+VERDICT r1 #5: exact mode for gram lengths 4..5, parity-tested against the
+pure-Python oracle with gram_lengths=(1..5), vocabMode="exact".
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_languagedetector_tpu import LanguageDetector, Table
+from spark_languagedetector_tpu.ops import score as S
+from spark_languagedetector_tpu.ops import vocab as V
+from spark_languagedetector_tpu.ops.cuckoo import build_cuckoo, lookup_numpy
+from spark_languagedetector_tpu.ops.encoding import pad_batch
+
+from .oracle import detect_oracle, fit_oracle
+
+
+def test_gram_key_bijective_and_matches_window_keys():
+    rng = np.random.default_rng(5)
+    grams = [bytes(rng.integers(0, 256, n, dtype=np.uint8)) for n in (1, 2, 3, 4, 5) for _ in range(20)]
+    keys = {V.gram_key(g) for g in grams}
+    assert len(keys) == len(set(grams))  # distinct grams ⇒ distinct keys
+    # window_keys (device) and window_keys_numpy agree with gram_key
+    doc = bytes(rng.integers(0, 256, 40, dtype=np.uint8))
+    batch = np.frombuffer(doc, dtype=np.uint8)[None, :]
+    for n in (1, 2, 3, 4, 5):
+        lo_d, hi_d = (np.asarray(a) for a in V.window_keys(jnp.asarray(batch), n))
+        lo_h, hi_h = V.window_keys_numpy(batch, n)
+        np.testing.assert_array_equal(lo_d, lo_h)
+        np.testing.assert_array_equal(hi_d, hi_h)
+        for i in range(len(doc) - n + 1):
+            assert (int(lo_h[0, i]), int(hi_h[0, i])) == V.gram_key(doc[i : i + n])
+
+
+def test_mix32_host_device_lockstep():
+    rng = np.random.default_rng(7)
+    lo = rng.integers(-(2**31), 2**31, 1000).astype(np.int32)
+    hi = rng.integers(0, 2**11, 1000).astype(np.int32)
+    host = V.mix32(lo, hi, 12345)
+    dev = np.asarray(V.mix32(jnp.asarray(lo), jnp.asarray(hi), 12345, xp=jnp))
+    np.testing.assert_array_equal(host, dev)
+
+
+def test_cuckoo_build_and_lookup_exact():
+    rng = np.random.default_rng(11)
+    grams = list({bytes(rng.integers(0, 256, int(n), dtype=np.uint8))
+                  for n in rng.integers(1, 6, 5000)})
+    keys = [V.gram_key(g) for g in grams]
+    lo = np.asarray([k[0] for k in keys], np.int32)
+    hi = np.asarray([k[1] for k in keys], np.int32)
+    table = build_cuckoo(lo, hi)
+    # every inserted key resolves to its own row
+    rows = lookup_numpy(table, lo, hi)
+    np.testing.assert_array_equal(rows, np.arange(len(grams)))
+    # absent keys miss
+    absent = [g for g in (bytes(rng.integers(0, 256, 5, dtype=np.uint8)) for _ in range(200))
+              if g not in set(grams)]
+    akeys = [V.gram_key(g) for g in absent]
+    arows = lookup_numpy(
+        table,
+        np.asarray([k[0] for k in akeys], np.int32),
+        np.asarray([k[1] for k in akeys], np.int32),
+    )
+    assert (arows == len(grams)).all()
+
+
+def test_device_cuckoo_rows_match_host():
+    rng = np.random.default_rng(13)
+    grams = list({bytes(rng.integers(0, 256, int(n), dtype=np.uint8))
+                  for n in rng.integers(4, 6, 500)})
+    keys = [V.gram_key(g) for g in grams]
+    lo = np.asarray([k[0] for k in keys], np.int32)
+    hi = np.asarray([k[1] for k in keys], np.int32)
+    table = build_cuckoo(lo, hi)
+    probe_lo = np.concatenate([lo, rng.integers(-(2**31), 2**31, 300).astype(np.int32)])
+    probe_hi = np.concatenate([hi, (rng.integers(4, 6, 300).astype(np.int32) << 8)])
+    host = lookup_numpy(table, probe_lo, probe_hi)
+    dev = np.asarray(
+        S._cuckoo_rows(
+            jnp.asarray(probe_lo), jnp.asarray(probe_hi),
+            jnp.asarray(table.entries()), len(grams),
+            table.seed1, table.seed2,
+        )
+    )
+    np.testing.assert_array_equal(host, dev)
+
+
+def test_exact_1to5_fit_transform_matches_oracle():
+    """The VERDICT done-criterion: gram_lengths=(1..5), vocabMode='exact'."""
+    train_pairs = [
+        ("de", "der schnelle braune fuchs springt über den faulen hund"),
+        ("de", "ein schöner tag im wald mit vielen bäumen und vögeln"),
+        ("en", "the quick brown fox jumps over the lazy dog today"),
+        ("en", "a beautiful day in the forest with many trees and birds"),
+    ]
+    langs = ["de", "en"]
+    glens = [1, 2, 3, 4, 5]
+    det = LanguageDetector(langs, glens, 40).set_vocab_mode("exact")
+    model = det.fit(Table({
+        "lang": [l for l, _ in train_pairs],
+        "fulltext": [t for _, t in train_pairs],
+    }))
+    assert model.profile.spec.mode == V.EXACT
+    assert model.profile.spec.gram_lengths == (1, 2, 3, 4, 5)
+    # fit parity: same gram set and weights as the oracle
+    gram_map = fit_oracle(train_pairs, langs, glens, 40)
+    assert set(model.gram_probabilities) == set(gram_map)
+    for g, w in gram_map.items():
+        np.testing.assert_allclose(model.gram_probabilities[g], w, rtol=1e-12)
+    # transform parity incl. short/empty/unseen docs (cuckoo membership path)
+    probes = ["der hund", "the dog", "", "a", "ab", "abc", "abcd",
+              "zzzz unrelated words", "schöne vögel fliegen"]
+    got = model.transform(Table({"fulltext": probes})).column("lang")
+    want = [detect_oracle(t, gram_map, langs, glens) for t in probes]
+    assert list(got) == want
+
+
+def test_exact_1to5_runner_uses_cuckoo_membership():
+    det = LanguageDetector(["de", "en"], [1, 2, 3, 4, 5], 20).set_vocab_mode("exact")
+    model = det.fit(Table({
+        "lang": ["de", "en"],
+        "fulltext": ["der schnelle fuchs", "the quick fox"],
+    }))
+    runner = model._get_runner()
+    assert runner.cuckoo is not None
+    assert runner.lut is None
+
+
+def test_exact_long_grams_reject_device_fit():
+    det = (
+        LanguageDetector(["de", "en"], [1, 4], 20)
+        .set_vocab_mode("exact")
+        .set_fit_backend("device")
+    )
+    with pytest.raises(ValueError, match="device"):
+        det.fit(Table({"lang": ["de", "en"], "fulltext": ["aaa bbb", "ccc ddd"]}))
+
+
+def test_score_batch_cuckoo_window_limit():
+    """Chunk-ownership masks apply to the cuckoo scorer too."""
+    rng = np.random.default_rng(17)
+    spec = V.VocabSpec(V.EXACT, (1, 4))
+    docs = [bytes(rng.integers(97, 105, 60, dtype=np.uint8)) for _ in range(4)]
+    grams = {d[i:i+4] for d in docs for i in range(len(d) - 3)}
+    grams |= {d[i:i+1] for d in docs for i in range(len(d))}
+    grams = sorted(grams)
+    keys = [V.gram_key(g) for g in grams]
+    table = build_cuckoo(
+        np.asarray([k[0] for k in keys], np.int32),
+        np.asarray([k[1] for k in keys], np.int32),
+    )
+    weights = np.concatenate([
+        rng.normal(size=(len(grams), 3)), np.zeros((1, 3))
+    ]).astype(np.float32)
+    batch, lengths = pad_batch(docs, pad_to=64)
+    kw = dict(seed1=table.seed1, seed2=table.seed2, spec=spec, block=128)
+    args = (
+        jnp.asarray(batch), jnp.asarray(lengths), jnp.asarray(weights),
+        jnp.asarray(table.entries()),
+    )
+    full = np.asarray(S.score_batch_cuckoo(*args, **kw))
+    limit = np.asarray([10, 60, 25, 1], np.int32)
+    limited = np.asarray(S.score_batch_cuckoo(*args, window_limit=jnp.asarray(limit), **kw))
+    # limited scores = scoring the owned prefix windows only
+    host_w, host_ids = weights, np.asarray([spec.gram_to_id(g) for g in grams], np.int64)
+    order = np.argsort(host_ids)
+    sw = np.concatenate([weights[:len(grams)][order], np.zeros((1, 3), np.float32)])
+    sids = host_ids[order]
+    for i, doc in enumerate(docs):
+        acc = np.zeros(3)
+        for n in spec.gram_lengths:
+            for s in range(len(doc) - n + 1):
+                if s < limit[i]:
+                    g = doc[s:s+n]
+                    pos = np.searchsorted(sids, spec.gram_to_id(g))
+                    if pos < len(sids) and sids[pos] == spec.gram_to_id(g):
+                        acc += sw[pos]
+        np.testing.assert_allclose(limited[i], acc, rtol=1e-4, atol=1e-4)
+    assert not np.allclose(full, limited)

@@ -445,6 +445,55 @@ class BatchRunner:
 
         return native.pack_batch(batch_docs, pad_to)
 
+    def _dispatch_batch(self, batch_np, lengths_np, limit_np, placement):
+        """Transfer one packed batch and dispatch its scoring computation
+        (async — errors may defer to the result fetch).
+
+        Explicit async device_put: passing numpy operands straight into the
+        jitted call makes the h2d copy synchronous on the dispatch path
+        (~8.7ms/batch over a tunneled TPU, measured), while device_put
+        returns immediately and overlaps the copy with packing the next
+        batch (~0.2ms dispatch). On a mesh the same put carries the
+        data-axis sharding and GSPMD partitions the jitted scorer across
+        devices.
+        """
+        batch = jax.device_put(batch_np, placement)
+        lengths = jax.device_put(lengths_np, placement)
+        window_limit = (
+            None if limit_np is None else jax.device_put(limit_np, placement)
+        )
+        if self.strategy == "pallas":
+            interpret, w1, w2 = self._pallas_state()
+            return self._pallas_dispatch(
+                batch, lengths, window_limit, placement,
+                interpret, self.spec, w1, w2,
+            )
+        if self.strategy == "hybrid":
+            # n ≤ 2 through the pallas histogram kernel over the dense
+            # sub-table; n ≥ 3 through the gather path. Both parts see the
+            # same window limits; each handles its own lengths'
+            # partial-window rules, so the sum is exact.
+            interpret, spec12, w1, w2, rest = self._hybrid_state()
+            return self._pallas_dispatch(
+                batch, lengths, window_limit, placement,
+                interpret, spec12, w1, w2,
+            ) + self._gather_scores(
+                batch, lengths, window_limit, rest,
+                block=min(self.block, 256),
+            )
+        if self.strategy == "onehot":
+            return score_ops.score_batch_onehot(
+                batch,
+                lengths,
+                self.weights,
+                spec=self.spec,
+                block=min(self.block, 1024),
+                window_limit=window_limit,
+            )
+        return self._gather_scores(
+            batch, lengths, window_limit, None, block=self.block
+        )
+
     def score(self, byte_docs: Sequence[bytes]) -> np.ndarray:
         """float32 [N, L] scores in input order (exact over any doc length)."""
         N = len(byte_docs)
@@ -517,73 +566,48 @@ class BatchRunner:
             rows = rows_for(pad_to)
             for start in range(0, len(carry), rows):
                 plan.append((np.asarray(carry[start : start + rows]), pad_to))
-        pending: list[tuple[np.ndarray, object]] = []
-        with self.metrics.timer("score_s"):
+        from ..utils.profiling import trace
+
+        def build_and_dispatch(sel: np.ndarray, pad_to: int):
+            """Pack one planned batch from the retained chunks and dispatch
+            it. Re-invocable: scoring is stateless, so a transient failure is
+            retried by replaying the batch verbatim — the micro-batch analog
+            of the streaming loop's replay-once (SURVEY.md §5.3)."""
+            batch_docs = [chunks[k] for k in sel]
+            batch_limits = [limits[k] for k in sel]
+            if self.mesh is not None:
+                # Sharded dispatch needs the row count divisible by the
+                # data axis; empty-doc pad rows score zero and are
+                # dropped below (scatter uses only the first len(sel)).
+                batch_docs, batch_limits = pad_rows_for_mesh(
+                    batch_docs,
+                    self._ndata,
+                    (batch_limits, self.max_chunk),
+                )
+            batch_np, lengths_np = self._pack(batch_docs, pad_to)
+            # Batches without chunked docs (the common case) skip the
+            # window-limit array entirely — one fewer host→device
+            # transfer and a simpler compiled program.
+            if all(lim == self.max_chunk for lim in batch_limits):
+                limit_np = None
+            else:
+                limit_np = np.asarray(batch_limits, dtype=np.int32)
+            return self._dispatch_batch(batch_np, lengths_np, limit_np, placement)
+
+        pending: list[tuple[np.ndarray, object, int]] = []
+        with trace(), self.metrics.timer("score_s"):
             for sel, pad_to in plan:
-                batch_docs = [chunks[k] for k in sel]
-                batch_limits = [limits[k] for k in sel]
-                if self.mesh is not None:
-                    # Sharded dispatch needs the row count divisible by the
-                    # data axis; empty-doc pad rows score zero and are
-                    # dropped below (scatter uses only the first len(sel)).
-                    batch_docs, batch_limits = pad_rows_for_mesh(
-                        batch_docs,
-                        self._ndata,
-                        (batch_limits, self.max_chunk),
-                    )
-                batch, lengths = self._pack(batch_docs, pad_to)
-                # Batches without chunked docs (the common case) skip the
-                # window-limit array entirely — one fewer host→device
-                # transfer and a simpler compiled program.
-                if all(lim == self.max_chunk for lim in batch_limits):
-                    window_limit = None
-                else:
-                    window_limit = np.asarray(batch_limits, dtype=np.int32)
-                # Explicit async device_put: passing numpy operands straight
-                # into the jitted call makes the h2d copy synchronous on the
-                # dispatch path (~8.7ms/batch over a tunneled TPU, measured),
-                # while device_put returns immediately and overlaps the copy
-                # with packing the next batch (~0.2ms dispatch). On a mesh
-                # the same put carries the data-axis sharding and GSPMD
-                # partitions the jitted scorer across devices.
-                batch = jax.device_put(batch, placement)
-                lengths = jax.device_put(lengths, placement)
-                if window_limit is not None:
-                    window_limit = jax.device_put(window_limit, placement)
-                if self.strategy == "pallas":
-                    interpret, w1, w2 = self._pallas_state()
-                    scores = self._pallas_dispatch(
-                        batch, lengths, window_limit, placement,
-                        interpret, self.spec, w1, w2,
-                    )
-                elif self.strategy == "hybrid":
-                    # n ≤ 2 through the pallas histogram kernel over the
-                    # dense sub-table; n ≥ 3 through the gather path. Both
-                    # parts see the same window limits; each handles its own
-                    # lengths' partial-window rules, so the sum is exact.
-                    interpret, spec12, w1, w2, rest = self._hybrid_state()
-                    scores = self._pallas_dispatch(
-                        batch, lengths, window_limit, placement,
-                        interpret, spec12, w1, w2,
-                    ) + self._gather_scores(
-                        batch, lengths, window_limit, rest,
-                        block=min(self.block, 256),
-                    )
-                elif self.strategy == "onehot":
-                    scores = score_ops.score_batch_onehot(
-                        batch,
-                        lengths,
-                        self.weights,
-                        spec=self.spec,
-                        block=min(self.block, 1024),
-                        window_limit=window_limit,
-                    )
-                else:
-                    scores = self._gather_scores(
-                        batch, lengths, window_limit, None, block=self.block
-                    )
-                # Async dispatch: keep packing while the device works.
-                pending.append((sel, scores))
+                try:
+                    scores = build_and_dispatch(sel, pad_to)
+                except Exception:
+                    log_event(_log, "runner.retry", rows=len(sel))
+                    self.metrics.incr("retries")
+                    scores = build_and_dispatch(sel, pad_to)
+                # Async dispatch: keep packing while the device works. Only
+                # (sel, pad_to) is retained for replay — the padded arrays
+                # are rebuilt from `chunks` in the rare fetch-failure path,
+                # so peak host RSS stays O(one batch), not O(corpus).
+                pending.append((sel, scores, pad_to))
                 self.metrics.incr("chunks_scored", len(sel))
 
             # Results stream back asynchronously: each batch's d2h copy is
@@ -593,15 +617,27 @@ class BatchRunner:
             # blocking per-batch np.asarray here would instead pay the full
             # device-sync latency once per batch (measured ~8ms over a
             # tunneled TPU).
-            for _, s in pending:
+            for _, s, _ in pending:
                 try:
                     s.copy_to_host_async()
-                except AttributeError:  # non-jax array (numpy test doubles)
+                except Exception:
+                    # Either a non-jax array (numpy test doubles) or a batch
+                    # whose deferred execution error surfaces here — the
+                    # fetch loop below retries it.
                     pass
             doc_idx_arr = np.asarray(doc_idx, dtype=np.int64)
-            for sel, s in pending:
+            for sel, s, pad_to in pending:
+                try:
+                    host = np.asarray(s)
+                except Exception:
+                    # A failure surfacing only at fetch time (async dispatch
+                    # defers execution errors here): replay the batch once,
+                    # synchronously.
+                    log_event(_log, "runner.retry_fetch", rows=len(sel))
+                    self.metrics.incr("retries")
+                    host = np.asarray(build_and_dispatch(sel, pad_to))
                 # Rows beyond len(sel) are mesh pad rows — dropped here.
-                np.add.at(out, doc_idx_arr[sel], np.asarray(s)[: len(sel)])
+                np.add.at(out, doc_idx_arr[sel], host[: len(sel)])
 
         self.metrics.incr("docs_scored", N)
         log_event(

@@ -35,6 +35,10 @@ COUNTS = "counts"
 WEIGHT_MODES = (PARITY, COUNTS)
 
 _FIT_BATCH = 1024  # docs per padded counting batch
+# Pending (unmerged per-batch unique) elements before an LSM-style merge into
+# the running accumulator — ~128MB of ids+counts. Module-level so tests can
+# shrink it to exercise flush boundaries.
+_PENDING_MERGE_LIMIT = 8_000_000
 
 
 @dataclass(frozen=True)
@@ -62,39 +66,66 @@ def extract_gram_counts(
     """
     lang_indices = np.asarray(lang_indices, dtype=np.int64)
     max_n = max(spec.gram_lengths)
-    pair_chunks: list[np.ndarray] = []
+
+    # Streaming reduction with bounded memory (the reference streams this
+    # through Spark shuffles, LanguageDetector.scala:52-66): each batch's
+    # raw window-id array is reduced to (unique pair, count) immediately,
+    # and the per-batch uniques merge LSM-style — deferred until the pending
+    # set is large enough to amortize the sort — so peak RSS is
+    # O(batch windows + distinct pairs), not O(total corpus windows).
+    acc_ids = np.zeros(0, np.int64)
+    acc_counts = np.zeros(0, np.int64)
+    pending: list[tuple[np.ndarray, np.ndarray]] = []
+    pending_elems = 0
+
+    def flush():
+        nonlocal acc_ids, acc_counts, pending, pending_elems
+        if not pending:
+            return
+        all_ids = np.concatenate([acc_ids] + [u for u, _ in pending])
+        all_counts = np.concatenate([acc_counts] + [c for _, c in pending])
+        acc_ids, inv = np.unique(all_ids, return_inverse=True)
+        # bincount sums in float64 — exact for counts below 2^53.
+        acc_counts = np.bincount(
+            inv, weights=all_counts.astype(np.float64)
+        ).astype(np.int64)
+        pending = []
+        pending_elems = 0
 
     for start in range(0, len(byte_docs), batch_size):
         docs = byte_docs[start : start + batch_size]
         langs = lang_indices[start : start + batch_size]
         batch, lengths = pad_batch(docs, pad_to=max(max(len(d) for d in docs), 1))
+        batch_chunks: list[np.ndarray] = []
         for n in spec.gram_lengths:
             ids = window_ids_numpy(batch, n, spec)  # [B, W]
             W = ids.shape[1]
             mask = np.arange(W)[None, :] <= (lengths[:, None] - n)
             lang_grid = np.broadcast_to(langs[:, None], ids.shape)
-            pair_chunks.append(
-                ids[mask] * num_langs + lang_grid[mask]
-            )
+            batch_chunks.append(ids[mask] * num_langs + lang_grid[mask])
         # Partial windows for docs shorter than some gram length.
         for i, doc in enumerate(docs):
             if len(doc) < max_n:
                 short = short_doc_ids_numpy(doc, spec)
                 if short:
-                    pair_chunks.append(
+                    batch_chunks.append(
                         np.asarray(short, dtype=np.int64) * num_langs + langs[i]
                     )
+        if batch_chunks:
+            u, c = np.unique(np.concatenate(batch_chunks), return_counts=True)
+            pending.append((u, c.astype(np.int64)))
+            pending_elems += len(u)
+            # Gate on the pending size alone: once the accumulator itself
+            # outgrows the limit, including it in the test would force a
+            # full re-sort after every batch (quadratic in corpus size).
+            if pending_elems > _PENDING_MERGE_LIMIT:
+                flush()
 
-    if not pair_chunks:
-        return GramCounts(
-            np.zeros(0, np.int64), np.zeros(0, np.int32), np.zeros(0, np.int64), num_langs
-        )
-    pairs = np.concatenate(pair_chunks)
-    unique_pairs, counts = np.unique(pairs, return_counts=True)
+    flush()
     return GramCounts(
-        ids=unique_pairs // num_langs,
-        langs=(unique_pairs % num_langs).astype(np.int32),
-        counts=counts.astype(np.int64),
+        ids=acc_ids // num_langs,
+        langs=(acc_ids % num_langs).astype(np.int32),
+        counts=acc_counts,
         num_langs=num_langs,
     )
 

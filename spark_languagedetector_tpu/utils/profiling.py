@@ -1,0 +1,43 @@
+"""jax.profiler trace hooks (SURVEY.md §5.1).
+
+The reference has no tracing of its own beyond Spark's UI; here the
+throughput meter (``utils.metrics``) is complemented by an opt-in
+``jax.profiler`` trace so a scoring or fit region can be captured for
+TensorBoard/XProf without touching call sites:
+
+    with trace("/tmp/langdetect-trace"):
+        model.transform(table)
+
+or environment-driven (no code change): set ``LANGDETECT_TRACE_DIR`` and
+every ``BatchRunner.score`` call traces itself.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+
+from .logging import get_logger, log_event
+
+_log = get_logger("utils.profiling")
+
+TRACE_DIR_ENV = "LANGDETECT_TRACE_DIR"
+
+
+@contextmanager
+def trace(log_dir: str | None = None):
+    """Profile the enclosed region to ``log_dir`` (or $LANGDETECT_TRACE_DIR).
+
+    No-op when neither is set, so production call sites can wrap hot regions
+    unconditionally.
+    """
+    log_dir = log_dir or os.environ.get(TRACE_DIR_ENV)
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        log_event(_log, "profiling.trace_start", dir=log_dir)
+        yield
+    log_event(_log, "profiling.trace_done", dir=log_dir)

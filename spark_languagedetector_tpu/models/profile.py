@@ -65,11 +65,27 @@ class GramProfile:
                 )
             if len(self.ids) > 1 and not bool(np.all(np.diff(self.ids) > 0)):
                 raise ValueError("profile ids must be strictly ascending")
+            if len(self.ids) and (
+                int(self.ids[0]) < 0
+                or int(self.ids[-1]) >= self.spec.id_space_size
+            ):
+                # A negative id would wrap through numpy indexing into the
+                # wrong table row — the same silent-corruption class as a
+                # NaN weight; reject at the boundary instead.
+                raise ValueError(
+                    f"profile ids must lie in [0, {self.spec.id_space_size}); "
+                    f"got range [{int(self.ids[0])}, {int(self.ids[-1])}]"
+                )
         if self.weights.shape[1] != len(self.languages):
             raise ValueError(
                 f"weights have {self.weights.shape[1]} columns for "
                 f"{len(self.languages)} languages"
             )
+        # Trust boundary: profiles are built from fit output or persisted
+        # artifacts; a NaN/Inf weight would silently corrupt every argmax.
+        from ..utils.debug import assert_finite
+
+        assert_finite(self.weights, "profile weights")
 
     @property
     def is_dense(self) -> bool:

@@ -1,0 +1,45 @@
+"""Numerical sanitizers (SURVEY.md §5.2).
+
+The reference's execution model (pure functions over Spark datasets) makes
+data races structurally impossible, and JAX's functional model carries the
+same property — so the remaining hazard class is *numerical*: NaN/Inf
+weights from corrupt inputs or buggy kernels silently win or lose argmax
+comparisons. Two defenses:
+
+* :func:`nan_checks` — a scoped switch for JAX's debug-nans mode, which
+  re-runs any jitted computation that produced a NaN in op-by-op mode and
+  raises at the originating op. Expensive; for tests and debugging sessions.
+* :func:`assert_finite` — a cheap explicit guard used at trust boundaries
+  (profile construction from persisted artifacts).
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import numpy as np
+
+
+@contextmanager
+def nan_checks(enabled: bool = True):
+    """Scoped ``jax_debug_nans``: any NaN produced under jit raises at the
+    op that made it (op-by-op re-execution). Restores the prior setting."""
+    import jax
+
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", enabled)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+def assert_finite(arr, what: str) -> None:
+    """Raise ValueError naming the artifact if ``arr`` has NaN/Inf."""
+    a = np.asarray(arr)
+    if a.size and not np.isfinite(a).all():
+        bad = int(a.size - np.isfinite(a).sum())
+        raise ValueError(
+            f"{what} contains {bad} non-finite value(s) (NaN/Inf) — "
+            "refusing to build a model from corrupt weights"
+        )

@@ -1,0 +1,103 @@
+"""Determinism + sanitizers (SURVEY.md §5.2).
+
+The reference gets race-freedom from Spark's model; JAX's functional model
+gives the same, so what's testable is *bitwise determinism* — identical
+inputs must produce identical profiles and identical scores, run to run and
+regardless of micro-batching — plus the NaN sanitizers.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_languagedetector_tpu import LanguageDetector, Table
+from spark_languagedetector_tpu.api.runner import BatchRunner
+from spark_languagedetector_tpu.ops.vocab import EXACT, VocabSpec
+from spark_languagedetector_tpu.utils.debug import assert_finite, nan_checks
+
+
+def _digest(*arrays) -> str:
+    h = hashlib.sha256()
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+_TRAIN = Table({
+    "lang": ["de", "de", "en", "en"],
+    "fulltext": [
+        "der schnelle braune fuchs", "über den faulen hund",
+        "the quick brown fox", "over the lazy dog",
+    ],
+})
+
+
+def test_fit_is_bitwise_deterministic():
+    digests = set()
+    for _ in range(3):
+        model = LanguageDetector(["de", "en"], [1, 2], 30).fit(_TRAIN)
+        digests.add(_digest(model.profile.ids, model.profile.weights))
+    assert len(digests) == 1
+
+
+def test_scores_bitwise_deterministic_across_batch_sizes():
+    spec = VocabSpec(EXACT, (1, 2))
+    rng = np.random.default_rng(19)
+    weights = rng.normal(size=(spec.id_space_size, 3)).astype(np.float32)
+    docs = [bytes(rng.integers(0, 256, int(rng.integers(0, 300)), dtype=np.uint8))
+            for _ in range(40)]
+    digests = set()
+    for bs in (4, 8, 40):
+        runner = BatchRunner(
+            weights=jnp.asarray(weights), lut=None, spec=spec,
+            batch_size=bs, strategy="gather",
+        )
+        digests.add(_digest(runner.score(docs)))
+    assert len(digests) == 1
+
+
+def test_assert_finite_rejects_nan_profile():
+    from spark_languagedetector_tpu.models.profile import GramProfile
+
+    spec = VocabSpec(EXACT, (2,))
+    weights = np.asarray([[0.5, np.nan]])
+    with pytest.raises(ValueError, match="non-finite"):
+        GramProfile(
+            spec=spec, languages=("de", "en"),
+            ids=np.asarray([300], np.int64), weights=weights,
+        )
+
+
+def test_rejects_out_of_range_ids():
+    from spark_languagedetector_tpu.models.profile import GramProfile
+
+    spec = VocabSpec(EXACT, (2,))
+    w = np.ones((1, 2))
+    with pytest.raises(ValueError, match="ids must lie"):
+        GramProfile(spec=spec, languages=("de", "en"),
+                    ids=np.asarray([-5], np.int64), weights=w)
+    with pytest.raises(ValueError, match="ids must lie"):
+        GramProfile(spec=spec, languages=("de", "en"),
+                    ids=np.asarray([spec.id_space_size], np.int64), weights=w)
+
+
+def test_nan_checks_scoped_flag():
+    import jax
+
+    prev = jax.config.jax_debug_nans
+    with nan_checks(True):
+        assert jax.config.jax_debug_nans is True
+        with pytest.raises(FloatingPointError):
+            jnp.log(jnp.zeros(2)) - jnp.log(jnp.zeros(2))  # inf - inf = nan
+    assert jax.config.jax_debug_nans == prev
+
+
+def test_assert_finite_passes_clean():
+    assert_finite(np.ones((3, 3)), "ok")  # no raise
+    assert_finite(np.zeros((0, 2)), "empty ok")

@@ -233,12 +233,14 @@ def run_config(num: int) -> dict:
         rows = [{"fulltext": t} for t in eval_docs]
         sink_rows = []
         run_stream(  # warmup: compile every shape outside the timed window
-            model, memory_source(rows, 2048), lambda t: None
+            model, memory_source(rows, 4096), lambda t: None, prefetch=1
         )
         times = []
         for _ in range(3):
             t0 = time.perf_counter()
-            q = run_stream(model, memory_source(rows, 2048), sink_rows.append)
+            q = run_stream(
+                model, memory_source(rows, 4096), sink_rows.append, prefetch=1
+            )
             times.append(time.perf_counter() - t0)
             sink_rows.clear()
         t_dev = min(times)

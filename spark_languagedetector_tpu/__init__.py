@@ -31,6 +31,7 @@ __all__ = [
     "Schema",
     "SpecialCharPreprocessor",
     "Table",
+    "init_distributed",
 ]
 
 
@@ -45,4 +46,13 @@ def __getattr__(name):
         from .models import preprocessing
 
         return getattr(preprocessing, name)
+    if name == "init_distributed":
+        # Multi-host entry point: call once per host process before building
+        # estimators/models; after it, every visible device (all hosts)
+        # participates in meshes and `backend="mesh"` scoring / device fit
+        # span the slice. No-op in single-process runs, so scripts can call
+        # it unconditionally. Args/env: see parallel.distributed.initialize.
+        from .parallel.distributed import initialize
+
+        return initialize
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -76,6 +76,65 @@ def test_stream_progress_callback():
     assert snapshots == [(1, 2), (2, 2), (3, 2)]
 
 
+def test_stream_prefetch_zero_synchronous_path():
+    rows = [{"fulltext": "ababab"}, {"fulltext": "xyxy"}] * 5
+    outputs = []
+    query = run_stream(
+        _model(),
+        memory_source(rows, batch_rows=3),
+        sink=lambda t: outputs.extend(t.column("lang").tolist()),
+        prefetch=0,
+    )
+    assert query.batches == 4
+    assert outputs == ["a", "x"] * 5
+
+
+def test_stream_prefetch_deep_pipeline_preserves_order():
+    rows = [{"fulltext": "ababab"}, {"fulltext": "xyxy"}] * 20
+    outputs = []
+    query = run_stream(
+        _model(),
+        memory_source(rows, batch_rows=4),
+        sink=lambda t: outputs.extend(t.column("lang").tolist()),
+        prefetch=3,
+    )
+    assert query.batches == 10
+    assert query.rows == 40
+    assert outputs == ["a", "x"] * 20
+
+
+def test_stream_prefetch_respects_max_batches():
+    rows = [{"fulltext": "ab"}] * 100
+    seen = []
+    query = run_stream(
+        _model(),
+        memory_source(rows, batch_rows=10),
+        sink=lambda t: seen.append(t.num_rows),
+        max_batches=3,
+        prefetch=2,
+    )
+    assert query.batches == 3
+    assert seen == [10, 10, 10]
+
+
+def test_stream_prefetch_retry_still_works():
+    rows = [{"fulltext": "ab"}] * 4
+    model = _model()
+    real_transform = model.transform
+    fails = {"left": 1}
+
+    def flaky(batch):
+        if fails["left"]:
+            fails["left"] -= 1
+            raise RuntimeError("transient device hiccup")
+        return real_transform(batch)
+
+    model.transform = flaky
+    query = run_stream(model, memory_source(rows, 2), sink=lambda t: None)
+    assert query.batches == 2
+    assert query.metrics.counters["retries"] == 1
+
+
 def test_kafka_source_gated_on_missing_dependency():
     with pytest.raises(RuntimeError, match="kafka-python"):
         next(kafka_source("topic", 10))

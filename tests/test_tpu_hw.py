@@ -81,3 +81,96 @@ def test_pallas_matches_gather_on_hardware():
     if "skip" in result:
         pytest.skip(result["skip"])
     assert result["max_abs_err"] < 1e-2
+
+
+_HIST_SCRIPT = r"""
+import json, sys
+import numpy as np
+import jax, jax.numpy as jnp
+
+if jax.default_backend() == "cpu":
+    print(json.dumps({"skip": "no accelerator"}))
+    sys.exit(0)
+
+from spark_languagedetector_tpu.ops import score as S
+from spark_languagedetector_tpu.ops import score_pallas as SP
+from spark_languagedetector_tpu.ops.encoding import pad_batch
+from spark_languagedetector_tpu.ops.vocab import EXACT, VocabSpec
+
+spec = VocabSpec(EXACT, (1, 2))
+rng = np.random.default_rng(29)
+L = SP.MAX_PALLAS_LANGS + 9  # histogram path (non-fused)
+weights = rng.normal(size=(spec.id_space_size, L)).astype(np.float32)
+docs = [b"", b"a"] + [
+    bytes(rng.integers(0, 256, int(rng.integers(1, 700)), dtype=np.uint8))
+    for _ in range(30)
+]
+batch, lengths = pad_batch(docs, pad_to=1024)
+batch, lengths = jnp.asarray(batch), jnp.asarray(lengths)
+w = jnp.asarray(weights)
+w1, w2 = SP.weight_views(w, spec)
+assert w2.ndim == 2
+got = np.asarray(SP.score_batch_pallas(batch, lengths, w1, w2, None, spec=spec))
+want = np.asarray(S.score_batch(batch, lengths, w, None, spec=spec))
+err = float(np.abs(got - want).max())
+print(json.dumps({"max_abs_err": err}))
+"""
+
+
+def test_hist_kernel_matches_gather_on_hardware():
+    result = _run_on_device(_HIST_SCRIPT)
+    if "skip" in result:
+        pytest.skip(result["skip"])
+    assert result["max_abs_err"] < 1e-2
+
+
+_CUCKOO_SCRIPT = r"""
+import json, sys
+import numpy as np
+import jax, jax.numpy as jnp
+
+if jax.default_backend() == "cpu":
+    print(json.dumps({"skip": "no accelerator"}))
+    sys.exit(0)
+
+from spark_languagedetector_tpu.ops import score as S
+from spark_languagedetector_tpu.ops import vocab as V
+from spark_languagedetector_tpu.ops.cuckoo import build_cuckoo
+from spark_languagedetector_tpu.ops.encoding import pad_batch
+from spark_languagedetector_tpu.ops.vocab import EXACT, VocabSpec
+
+spec = VocabSpec(EXACT, (1, 4, 5))
+rng = np.random.default_rng(31)
+docs = [bytes(rng.integers(97, 105, int(rng.integers(1, 400)), dtype=np.uint8))
+        for _ in range(24)]
+grams = sorted({d[i:i+n] for d in docs for n in (1, 4, 5)
+                for i in range(max(len(d) - n + 1, 0))})[:5000]
+keys = [V.gram_key(g) for g in grams]
+table = build_cuckoo(
+    np.asarray([k[0] for k in keys], np.int32),
+    np.asarray([k[1] for k in keys], np.int32),
+)
+weights = np.concatenate(
+    [rng.normal(size=(len(grams), 3)), np.zeros((1, 3))]
+).astype(np.float32)
+batch, lengths = pad_batch(docs, pad_to=512)
+got = np.asarray(S.score_batch_cuckoo(
+    jnp.asarray(batch), jnp.asarray(lengths), jnp.asarray(weights),
+    jnp.asarray(table.entries()),
+    seed1=table.seed1, seed2=table.seed2, spec=spec,
+))
+# host oracle via sorted-id searchsorted
+ids = np.asarray([spec.gram_to_id(g) for g in grams], np.int64)
+order = np.argsort(ids)
+sw = np.concatenate([weights[:len(grams)][order], np.zeros((1, 3), np.float32)])
+want = S.score_batch_numpy(docs, sw, ids[order], spec)
+err = float(np.abs(got - want).max())
+print(json.dumps({"max_abs_err": err}))
+"""
+
+
+def test_cuckoo_scorer_matches_host_on_hardware():
+    result = _run_on_device(_CUCKOO_SCRIPT)
+    if "skip" in result:
+        pytest.skip(result["skip"])
+    assert result["max_abs_err"] < 1e-2

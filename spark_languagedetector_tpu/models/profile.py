@@ -8,17 +8,18 @@ TPU-native state is columnar: a sorted id vector plus a compact weight matrix
 bucket-table form. The map view is still offered for API/test parity
 (``gram_probabilities``).
 
-Device view strategy (``device_arrays``): there is no TPU analog of the
+Device view strategy (``device_membership``): there is no TPU analog of the
 reference's pointer-chasing hash lookup, and binary search (``searchsorted``)
 lowers to a serial scan — so membership is resolved by *tables*:
 
 * when the dense ``[id_space, L]`` weight table fits a budget, window ids
-  index it directly (one gather, and the one-hot MXU strategy applies for
-  gram lengths ≤ 2);
+  index it directly (one gather, and the one-hot/pallas MXU strategies apply
+  for gram lengths ≤ 2);
 * otherwise a dense int32 ``[id_space]`` lookup table maps ids to rows of a
-  compact ``[G+1, L]`` table (row G zeros for misses) — two small gathers,
-  with the id_space capped at 2^24ish by VocabSpec (exact n ≤ 3) or
-  2^hash_bits (hashed).
+  compact ``[G+1, L]`` table (row G zeros for misses) — two small gathers —
+  for id spaces that fit int32 (exact n ≤ 3, hashed 2^bits);
+* exact gram lengths 4..5 exceed any int32 id space, so membership ships as
+  a cuckoo hash table over packed byte keys (``ops.cuckoo``).
 """
 
 from __future__ import annotations

@@ -3,8 +3,8 @@
 Replaces the reference's per-row hot loop — per-window JVM hash-map lookup +
 ``BLAS.axpy`` accumulate + Breeze argmax
 (``/root/reference/src/main/.../LanguageDetectorModel.scala:131-156``) — with
-fixed-shape, jit-compiled pipelines. Three TPU strategies, picked by the
-profile's device view (``models.profile.GramProfile.device_arrays``):
+fixed-shape, jit-compiled pipelines. The XLA strategies here, picked by the
+profile's device view (``models.profile.GramProfile.device_membership``):
 
 * **dense gather** (``lut=None``): the weight table covers the whole id space
   ``[V, L]`` and window ids index it directly — one gather per window.
@@ -13,12 +13,20 @@ profile's device view (``models.profile.GramProfile.device_arrays``):
   Replaces binary-search membership — ``jnp.searchsorted`` lowers to a
   serial scan on TPU and measured ~40ms per [256, 2048] batch, vs ~4ms for
   the LUT gather.
+* **cuckoo gather** (:func:`score_batch_cuckoo`): exact gram lengths 4..5
+  overflow the int32 id space, so membership resolves through packed
+  ``(lo, hi)`` key pairs and a two-choice cuckoo table (``ops.cuckoo``) —
+  two wide gathers + verification per window.
 * **one-hot MXU** (:func:`score_batch_onehot`): for exact vocabularies with
   gram lengths ⊆ {1, 2}, scoring needs no gathers at all — the bigram
   histogram of a window block is the outer product of the two byte one-hots,
   a ``[W, 256]ᵀ @ [W, 256]`` batched matmul on the MXU, and scores are
   ``hist @ W``. This is the north star's "histogram × log-prob matrix as one
   matmul" (BASELINE.json) in its purest form.
+
+The pallas strategies (fused kernel, per-doc histogram kernel, and the
+hybrid composition with these gathers) live in :mod:`ops.score_pallas` and
+:mod:`api.runner`.
 
 The window axis is processed in blocks under ``lax.scan`` so peak memory is
 ``B·block·L`` (gather) or ``B·block·256`` (one-hot) regardless of document

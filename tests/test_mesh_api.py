@@ -125,3 +125,39 @@ def test_mesh_runner_gather_strategy_with_lut(eight_devices):
     np.testing.assert_array_equal(
         single, runner.score([t.encode() for t in EVAL])
     )
+
+
+def test_mesh_cuckoo_membership_matches_single_device(eight_devices):
+    """Exact gram lengths 4..5 (cuckoo membership) under GSPMD: entries
+    replicate, batches shard over the data axis."""
+    det = LanguageDetector(LANGS, [1, 4], 200).set_vocab_mode("exact")
+    model = det.fit(Table(ROWS))
+    runner = model._get_runner()
+    assert runner.cuckoo is not None
+    docs = [t.encode() for t in EVAL]
+    single = runner.score(docs)
+    model.set_backend("mesh")
+    meshed_runner = model._get_runner()
+    assert meshed_runner.mesh is not None
+    np.testing.assert_allclose(
+        single, meshed_runner.score(docs), rtol=1e-5, atol=1e-4
+    )
+
+
+def test_mesh_hybrid_strategy_matches_single_device(eight_devices):
+    """Hybrid (pallas n<=2 under shard_map + gather n>=3 under GSPMD) on a
+    mesh, including a chunked long doc."""
+    det = LanguageDetector(LANGS, [1, 2, 3], 300).set_vocab_mode("exact")
+    model = det.fit(Table(ROWS))
+    weights, lut, cuckoo = model.profile.device_membership()
+    docs = [t.encode() for t in EVAL]
+    single = BatchRunner(
+        weights=weights, lut=lut, cuckoo=cuckoo,
+        spec=model.profile.spec, batch_size=8, strategy="hybrid",
+    ).score(docs)
+    meshed = BatchRunner(
+        weights=weights, lut=lut, cuckoo=cuckoo,
+        spec=model.profile.spec, batch_size=8, strategy="hybrid",
+        mesh=resolve_mesh("mesh"),
+    ).score(docs)
+    np.testing.assert_allclose(single, meshed, rtol=1e-4, atol=1e-3)

@@ -33,6 +33,9 @@ Environment knobs:
     BENCH_CONFIGS        comma list, default "2,3,4,5,1" (1 last = headline)
     BENCH_DOCS           override eval-doc count for every config
     BENCH_BASELINE_DOCS  override baseline-doc count for every config
+    BENCH_SOFT_BUDGET_S  soft wall-clock budget (default 420): once spent,
+                         intermediate configs are skipped (noted on stderr)
+                         so the final/headline config always runs
 """
 
 from __future__ import annotations
@@ -236,7 +239,9 @@ def run_config(num: int) -> dict:
             model, memory_source(rows, 4096), lambda t: None, prefetch=1
         )
         times = []
-        for _ in range(3):
+        # Streaming is transfer-bound like the other short-gram configs:
+        # same extra-pass rule.
+        for _ in range(5 if max(cfg["gram_lengths"]) <= 3 else 3):
             t0 = time.perf_counter()
             q = run_stream(
                 model, memory_source(rows, 4096), sink_rows.append, prefetch=1
@@ -326,8 +331,23 @@ def main():
         for c in os.environ.get("BENCH_CONFIGS", "2,3,4,5,1").split(",")
         if c.strip()
     ]
+    # Soft wall-clock budget: a full five-config run is dominated by one-off
+    # jit compiles (~6 min through a remote-compile tunnel). If a driver
+    # enforces a timeout, the headline config (last in the list) must still
+    # run — so once the budget is spent, intermediate configs are skipped
+    # (noted on stderr) and the run jumps straight to the final config.
+    budget_s = float(os.environ.get("BENCH_SOFT_BUDGET_S", "420"))
+    t_start = time.perf_counter()
     failures = 0
-    for num in order:
+    for i, num in enumerate(order):
+        last = i == len(order) - 1
+        if not last and time.perf_counter() - t_start > budget_s:
+            print(
+                json.dumps({"config": num, "skipped": "soft time budget"}),
+                file=sys.stderr,
+                flush=True,
+            )
+            continue
         try:
             print(json.dumps(run_config(num)), flush=True)
         except SystemExit:

@@ -41,6 +41,12 @@ from ..utils.metrics import Metrics
 
 _log = get_logger("api.runner")
 
+# Failures worth replaying a micro-batch for: runtime/transfer errors from
+# the device or the tunnel (XlaRuntimeError is a RuntimeError subclass) and
+# host I/O. Programming errors (TypeError, ValueError, shape bugs) propagate
+# immediately with their original traceback instead of being re-executed.
+RETRYABLE = (RuntimeError, OSError)
+
 DEFAULT_BATCH_SIZE = 256
 # The fused pallas kernel keeps per-document state in VMEM scratch (no
 # O(B·vocab) HBM buffers), so its sweet spot is much larger micro-batches —
@@ -188,10 +194,7 @@ class BatchRunner:
             # slow outside tests); gather fallback. On a mesh the XLA
             # strategies partition via GSPMD and the pallas kernel runs
             # per-shard under shard_map — all strategies qualify.
-            if self.mesh is not None:
-                target = list(self.mesh.devices.flat)[0]
-            else:
-                target = self.device or jax.devices()[0]
+            target = self._target_device()
             if pallas_ok and target.platform == "tpu":
                 self.strategy = "pallas"
             elif hybrid_ok and target.platform == "tpu":
@@ -235,6 +238,15 @@ class BatchRunner:
     @property
     def max_chunk(self) -> int:
         return self.length_buckets[-1]
+
+    def _target_device(self):
+        """The device this runner's programs actually run on: a mesh's
+        devices decide the platform (not the process default — a CPU mesh
+        with a TPU default backend must still count as CPU), else the
+        explicit device, else the process default."""
+        if self.mesh is not None:
+            return self.mesh.devices.flat[0]
+        return self.device or jax.devices()[0]
 
     def _hybrid_supported(self) -> bool:
         """Vocab with both short (≤ 2) and long (> 2) gram lengths whose
@@ -302,8 +314,7 @@ class BatchRunner:
             else:
                 dense12 = jnp.asarray(self.weights)[:V12]
             w1, w2 = score_pallas.weight_views(dense12, spec12)
-            target = self.device or jax.devices()[0]
-            interpret = target.platform != "tpu"
+            interpret = self._target_device().platform != "tpu"
             if self.device is not None:
                 w1 = jax.device_put(w1, self.device)
                 w2 = jax.device_put(w2, self.device)
@@ -324,10 +335,9 @@ class BatchRunner:
                     "strategy='pallas' needs an exact vocab with gram "
                     "lengths <= 2 and the dense weight table"
                 )
-            target = self.device or jax.devices()[0]
             # Mosaic only lowers on TPU; anywhere else (CPU tests, GPU) the
             # explicit pallas strategy runs in interpret mode.
-            interpret = target.platform != "tpu"
+            interpret = self._target_device().platform != "tpu"
             w1, w2 = score_pallas.weight_views(self.weights, self.spec)
             if self.device is not None:
                 w1 = jax.device_put(w1, self.device)
@@ -599,8 +609,8 @@ class BatchRunner:
             for sel, pad_to in plan:
                 try:
                     scores = build_and_dispatch(sel, pad_to)
-                except Exception:
-                    log_event(_log, "runner.retry", rows=len(sel))
+                except RETRYABLE as e:
+                    log_event(_log, "runner.retry", rows=len(sel), error=repr(e))
                     self.metrics.incr("retries")
                     scores = build_and_dispatch(sel, pad_to)
                 # Async dispatch: keep packing while the device works. Only
@@ -620,20 +630,22 @@ class BatchRunner:
             for _, s, _ in pending:
                 try:
                     s.copy_to_host_async()
-                except Exception:
-                    # Either a non-jax array (numpy test doubles) or a batch
-                    # whose deferred execution error surfaces here — the
-                    # fetch loop below retries it.
+                except (AttributeError, *RETRYABLE):
+                    # AttributeError: non-jax array (numpy test doubles).
+                    # Runtime errors: a batch whose deferred execution error
+                    # surfaces here — the fetch loop below retries it.
                     pass
             doc_idx_arr = np.asarray(doc_idx, dtype=np.int64)
             for sel, s, pad_to in pending:
                 try:
                     host = np.asarray(s)
-                except Exception:
+                except RETRYABLE as e:
                     # A failure surfacing only at fetch time (async dispatch
                     # defers execution errors here): replay the batch once,
                     # synchronously.
-                    log_event(_log, "runner.retry_fetch", rows=len(sel))
+                    log_event(
+                        _log, "runner.retry_fetch", rows=len(sel), error=repr(e)
+                    )
                     self.metrics.incr("retries")
                     host = np.asarray(build_and_dispatch(sel, pad_to))
                 # Rows beyond len(sel) are mesh pad rows — dropped here.

@@ -244,7 +244,11 @@ def score_batch_cuckoo(
     into the packed [M, 4] entry table + key verification. ``weights`` is
     the compact [G+1, L] table with the zeros miss row at G.
     """
-    assert spec.mode == EXACT
+    if spec.mode != EXACT:
+        raise ValueError(
+            "score_batch_cuckoo needs an exact vocab spec — hashed specs "
+            "use integer-id scoring (score_batch), not packed-key membership"
+        )
     B, S = batch.shape
     L = weights.shape[1]
     G = weights.shape[0] - 1
@@ -307,7 +311,12 @@ def score_batch_onehot(
     ``weights`` must be the dense [id_space, L] table (length-1 rows first,
     then length-2 rows — the ``VocabSpec.offsets`` layout).
     """
-    assert spec.mode == EXACT and max(spec.gram_lengths) <= ONEHOT_MAX_N
+    if spec.mode != EXACT or max(spec.gram_lengths) > ONEHOT_MAX_N:
+        raise ValueError(
+            "score_batch_onehot needs an exact vocab with gram lengths <= "
+            f"{ONEHOT_MAX_N} (got mode={spec.mode!r}, "
+            f"lengths={spec.gram_lengths})"
+        )
     B, S = batch.shape
     max_n = max(spec.gram_lengths)
     if S < max_n:  # batch narrower than the largest window: zero-extend

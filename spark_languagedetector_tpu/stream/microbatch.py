@@ -124,12 +124,13 @@ def run_stream(
             return model.transform(batch)
         except Exception:  # transient failure: replay once (stateless)
             log_event(_log, "stream.retry", batch=seq)
-            # Sole writer of this counter is the (single) worker thread —
-            # or the caller's thread when prefetch=0 — so the read-modify-
-            # write below never races the main thread's other counters.
+            # May run on the worker thread concurrently with the caller's
+            # counter writes — Metrics serializes internally.
             query.metrics.incr("retries")
             return model.transform(batch)
 
+    # Exactly one worker: device dispatch must stay serialized (JAX's async
+    # queue is the pipeline; a second dispatcher would interleave programs).
     executor = ThreadPoolExecutor(max_workers=1) if prefetch > 0 else None
     in_flight: deque = deque()  # (batch, seq, future-or-None)
     seq = 0

@@ -1,13 +1,16 @@
 """Lightweight counters/timers for observability (SURVEY.md §5.5).
 
 The reference has no metrics at all; the BASELINE target (docs/sec/chip) makes
-a throughput meter mandatory. These counters are process-local and lock-free
-(CPython atomic int ops) — device-side timing uses ``block_until_ready``
-explicitly at the call sites that care.
+a throughput meter mandatory. These counters are process-local; writes take a
+lock so producers on other threads (e.g. the streaming engine's prefetch
+worker) can update counters concurrently with the caller's thread — the cost
+is per-batch, not per-row, so it never shows in a profile. Device-side timing
+uses ``block_until_ready`` explicitly at the call sites that care.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import defaultdict
 from contextlib import contextmanager
@@ -18,9 +21,25 @@ from dataclasses import dataclass, field
 class Metrics:
     counters: dict[str, int] = field(default_factory=lambda: defaultdict(int))
     timers: dict[str, float] = field(default_factory=lambda: defaultdict(float))
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
+
+    # Locks can't be pickled/deepcopied — models deepcopy themselves (and the
+    # runner's Metrics with them) in Params.copy. Copies get a fresh lock;
+    # counter values transfer as plain dicts.
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        del state["_lock"]
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
 
     def incr(self, name: str, value: int = 1) -> None:
-        self.counters[name] += value
+        with self._lock:
+            self.counters[name] += value
 
     @contextmanager
     def timer(self, name: str):
@@ -28,7 +47,9 @@ class Metrics:
         try:
             yield
         finally:
-            self.timers[name] += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self.timers[name] += dt
 
     def throughput(self, counter: str, timer: str) -> float:
         """counter/sec over accumulated timer time; 0.0 if never timed."""

@@ -16,26 +16,36 @@ Wikipedia/CommonCrawl dumps; none are available in this zero-egress image,
 so the baseline is *measured, not cited* (BASELINE.md) on the same synthetic
 corpus for both sides.
 
-Two baseline columns per config:
-  * ``baseline_docs_per_s`` — the reference's per-row scoring semantics
-    (per-window dict lookup + vector accumulate,
-    LanguageDetectorModel.scala:139-152) reimplemented in pure Python. This
-    is the vs_baseline denominator; it is Python-per-row, NOT the JVM.
-  * ``baseline_numpy_docs_per_s`` — the strongest CPU implementation this
-    repo ships (vectorized numpy host scorer), so the device multiple can't
-    be read as a vs-JVM claim.
+Two baseline denominators per config, reported side by side:
+  * ``vs_baseline`` / ``baseline_docs_per_s`` — the reference's per-row
+    scoring semantics (per-window dict lookup + vector accumulate,
+    LanguageDetectorModel.scala:139-152) reimplemented in pure Python.
+    Python-per-row, NOT the JVM — flattering; read it as a semantics
+    anchor, not a vs-reference claim.
+  * ``vs_numpy`` / ``baseline_numpy_docs_per_s`` — the strongest CPU
+    implementation this repo ships (vectorized numpy host scorer). The
+    honest denominator: closest in spirit to the reference's JVM+BLAS
+    hot loop.
+
+Each line also carries ``compute_docs_per_s``: device throughput with
+operands already resident (no host->device wire), so kernel progress stays
+visible when the tunnel's bandwidth — which bounds end-to-end ``value`` —
+varies (the wire is a relay here, ~30-90MB/s bursty).
 
 Accuracy parity is a hard gate per config: if device argmax labels disagree
-with the per-row baseline on the comparison subset, the script exits nonzero
-instead of reporting perf.
+with the per-row baseline on the comparison subset (>= 1000 docs or the
+whole eval set), the script exits nonzero instead of reporting perf.
 
 Environment knobs:
     BENCH_CONFIGS        comma list, default "2,3,4,5,1" (1 last = headline)
     BENCH_DOCS           override eval-doc count for every config
-    BENCH_BASELINE_DOCS  override baseline-doc count for every config
-    BENCH_SOFT_BUDGET_S  soft wall-clock budget (default 420): once spent,
+    BENCH_BASELINE_DOCS  override baseline/parity-doc count for every config
+    BENCH_SOFT_BUDGET_S  soft wall-clock budget (default 480): once spent,
                          intermediate configs are skipped (noted on stderr)
                          so the final/headline config always runs
+    SLD_TPU_TESTS        "1" => also run the real-TPU parity suite
+                         (tests/test_tpu_hw.py) after the headline config,
+                         reporting to stderr (stdout stays parseable)
 """
 
 from __future__ import annotations
@@ -188,10 +198,20 @@ def fit_model(cfg):
 
 
 def measure_baselines(model, cfg, eval_docs):
-    """(per-row docs/s, numpy docs/s, per-row argmax labels) on the subset."""
+    """(per-row docs/s, numpy docs/s, per-row argmax labels) on the subset.
+
+    The parity/denominator subset is >= 1000 docs (or the whole eval set if
+    smaller): large enough that the parity gate is meaningful per config and
+    the CPU rates are stable, still minutes-cheap next to jit compiles.
+    """
     from spark_languagedetector_tpu.ops.score import score_batch_numpy
 
-    n = int(os.environ.get("BENCH_BASELINE_DOCS", cfg["baseline_docs"]))
+    n = int(
+        os.environ.get(
+            "BENCH_BASELINE_DOCS",
+            max(cfg["baseline_docs"], min(1000, len(eval_docs))),
+        )
+    )
     if n <= 0:
         return None, None, None, []
     sub = eval_docs[:n]
@@ -212,6 +232,39 @@ def measure_baselines(model, cfg, eval_docs):
     score_batch_numpy([t.encode("utf-8") for t in sub], cw, cids, spec)
     t_np = time.perf_counter() - t0
     return len(sub) / t_base, len(sub) / t_np, [int(np.argmax(s)) for s in base], sub
+
+
+def measure_compute_only(model, eval_docs):
+    """Device docs/s with operands already resident — no host->device wire.
+
+    Packs one full-size micro-batch of real eval docs (truncated to the
+    widest length bucket; rate measurement, not scoring output), puts it on
+    device once, then times 10 queued dispatches per repetition with a
+    single reduced-scalar fetch (the axon-relay methodology: per-call d2h
+    syncs would measure tunnel latency, not compute).
+    """
+    import jax
+
+    runner = model._get_runner()
+    pad_to = runner.max_chunk
+    docs_b = [t.encode("utf-8")[:pad_to] for t in eval_docs[: runner.batch_size]]
+    batch_np, lengths_np = runner._pack(docs_b, pad_to)
+    if runner.mesh is not None:
+        return None  # single-device measurement only
+    batch = jax.device_put(batch_np, runner.device)
+    lengths = jax.device_put(lengths_np, runner.device)
+    out = runner._dispatch_batch(batch, lengths, None, runner.device)
+    np.asarray(out)  # warm: compile + first run outside the timed window
+    best = np.inf
+    for _ in range(3):
+        t0 = time.perf_counter()
+        acc = None
+        for _ in range(10):
+            s = runner._dispatch_batch(batch, lengths, None, runner.device)
+            acc = s.sum() if acc is None else acc + s.sum()
+        float(np.asarray(acc))
+        best = min(best, time.perf_counter() - t0)
+    return 10 * len(docs_b) / best
 
 
 def run_config(num: int) -> dict:
@@ -236,15 +289,16 @@ def run_config(num: int) -> dict:
         rows = [{"fulltext": t} for t in eval_docs]
         sink_rows = []
         run_stream(  # warmup: compile every shape outside the timed window
-            model, memory_source(rows, 4096), lambda t: None, prefetch=1
+            model, memory_source(rows, 4096), lambda t: None, prefetch=3
         )
         times = []
         # Streaming is transfer-bound like the other short-gram configs:
-        # same extra-pass rule.
+        # same extra-pass rule. prefetch=3 keeps the wire busy across
+        # batches (two transform workers overlap transfer with fetch).
         for _ in range(5 if max(cfg["gram_lengths"]) <= 3 else 3):
             t0 = time.perf_counter()
             q = run_stream(
-                model, memory_source(rows, 4096), sink_rows.append, prefetch=1
+                model, memory_source(rows, 4096), sink_rows.append, prefetch=3
             )
             times.append(time.perf_counter() - t0)
             sink_rows.clear()
@@ -300,9 +354,7 @@ def run_config(num: int) -> dict:
 
     import jax
 
-    strategy = None
-    if not cfg.get("streaming"):
-        strategy = model._get_runner().strategy
+    compute_dps = measure_compute_only(model, eval_docs)
     result = {
         "metric": f"langid docs/sec/chip ({cfg['label']}, {jax.default_backend()})",
         "value": round(device_dps, 1),
@@ -311,13 +363,20 @@ def run_config(num: int) -> dict:
         "median_docs_per_s": round(median_dps, 1),
         "baseline_kind": "python-per-row (reference hot-loop semantics)",
         "argmax_parity": parity,
+        "parity_docs": len(sub),
         "eval_docs": n_docs,
         "eval_mb": round(eval_bytes / 1e6, 1),
     }
-    if strategy:
-        result["strategy"] = strategy
+    if compute_dps:
+        # Conservative kernel rate: full-width docs (truncated to the widest
+        # bucket), resident operands. End-to-end `value` can exceed it when
+        # the real corpus is shorter than the bucket width.
+        result["compute_docs_per_s"] = round(compute_dps, 1)
+    if not cfg.get("streaming"):
+        result["strategy"] = model._get_runner().strategy
     if baseline_dps:
         result["vs_baseline"] = round(device_dps / baseline_dps, 2)
+        result["vs_numpy"] = round(device_dps / baseline_np_dps, 2)
         result["baseline_docs_per_s"] = round(baseline_dps, 1)
         result["baseline_numpy_docs_per_s"] = round(baseline_np_dps, 1)
     if cfg.get("streaming"):
@@ -336,7 +395,7 @@ def main():
     # enforces a timeout, the headline config (last in the list) must still
     # run — so once the budget is spent, intermediate configs are skipped
     # (noted on stderr) and the run jumps straight to the final config.
-    budget_s = float(os.environ.get("BENCH_SOFT_BUDGET_S", "420"))
+    budget_s = float(os.environ.get("BENCH_SOFT_BUDGET_S", "480"))
     t_start = time.perf_counter()
     failures = 0
     for i, num in enumerate(order):
@@ -361,8 +420,53 @@ def main():
                 file=sys.stderr,
                 flush=True,
             )
+    run_tpu_hw_tests()
     if failures:
         sys.exit(1)
+
+
+def run_tpu_hw_tests():
+    """Opt-in real-hardware Mosaic parity suite, after the headline config.
+
+    Runs with SLD_TPU_TESTS=1 so the opt-in tests in tests/test_tpu_hw.py
+    execute on the actual chip once per bench run. Reports to STDERR only —
+    stdout's last line must stay the headline config's JSON (drivers
+    tail-parse it) — and a hung tunnel is bounded by a subprocess timeout.
+
+    The suite runs in a subprocess, which needs a device stack that admits a
+    second client while this process holds the chip (true of the axon relay
+    here). On a co-located single-client libtpu, run the suite standalone
+    instead: SLD_TPU_TESTS=1 pytest tests/test_tpu_hw.py.
+    """
+    if os.environ.get("SLD_TPU_TESTS", "") != "1":
+        return
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", "tests/test_tpu_hw.py", "-q"],
+            cwd=here,
+            env={**os.environ, "SLD_TPU_TESTS": "1"},
+            capture_output=True,
+            text=True,
+            timeout=float(os.environ.get("SLD_TPU_TESTS_TIMEOUT_S", "300")),
+        )
+        tail = (proc.stdout or "").strip().splitlines()[-1:]
+        print(
+            json.dumps(
+                {
+                    "tpu_hw_tests": "passed" if proc.returncode == 0 else "FAILED",
+                    "detail": tail[0] if tail else "",
+                }
+            ),
+            file=sys.stderr,
+            flush=True,
+        )
+    except subprocess.TimeoutExpired:
+        print(
+            json.dumps({"tpu_hw_tests": "timeout"}), file=sys.stderr, flush=True
+        )
 
 
 if __name__ == "__main__":

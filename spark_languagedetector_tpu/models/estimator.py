@@ -17,6 +17,7 @@ persistence — plus the BASELINE north star's ``backend`` switch.
 
 from __future__ import annotations
 
+import threading
 from typing import Sequence
 
 import numpy as np
@@ -297,6 +298,11 @@ class LanguageDetectorModel(HasInputCol, HasOutputCol):
             batchSize=None,
         )
         self._runner: BatchRunner | None = None
+        # Concurrent transforms (the streaming engine runs >1 transform
+        # worker) must not each build a runner: construction uploads device
+        # arrays and triggers jit compiles, and last-writer-wins would leak
+        # the loser's buffers.
+        self._runner_lock = threading.Lock()
 
     # -- constructors mirroring reference conveniences ------------------------
     @staticmethod
@@ -360,21 +366,36 @@ class LanguageDetectorModel(HasInputCol, HasOutputCol):
         new._runner = None  # never share a runner (device arrays) via deepcopy
         return new
 
+    # Locks can't be deepcopied/pickled (Params.copy deepcopies the model);
+    # drop the runner with the lock — copies rebuild both lazily.
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state.pop("_runner_lock", None)
+        state["_runner"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._runner_lock = threading.Lock()
+
     def _get_runner(self) -> BatchRunner:
-        if self._runner is None:
-            weights, lut, cuckoo = self.profile.device_membership()
-            backend = self.get("backend")
-            mesh = resolve_mesh(backend)
-            self._runner = BatchRunner(
-                weights=weights,
-                lut=lut,
-                cuckoo=cuckoo,
-                spec=self.profile.spec,
-                batch_size=self.get("batchSize"),
-                device=None if mesh is not None else resolve_device(backend),
-                mesh=mesh,
-            )
-        return self._runner
+        with self._runner_lock:
+            if self._runner is None:
+                weights, lut, cuckoo = self.profile.device_membership()
+                backend = self.get("backend")
+                mesh = resolve_mesh(backend)
+                self._runner = BatchRunner(
+                    weights=weights,
+                    lut=lut,
+                    cuckoo=cuckoo,
+                    spec=self.profile.spec,
+                    batch_size=self.get("batchSize"),
+                    device=(
+                        None if mesh is not None else resolve_device(backend)
+                    ),
+                    mesh=mesh,
+                )
+            return self._runner
 
     def transform(self, dataset: Table) -> Table:
         out_schema = self.transform_schema(dataset.schema)
@@ -420,9 +441,18 @@ class _ModelWriter:
     def __init__(self, model: LanguageDetectorModel):
         self._model = model
         self._overwrite = False  # MLWriter contract: destructive only after .overwrite()
+        self._layout = "native"
 
     def overwrite(self) -> "_ModelWriter":
         self._overwrite = True
+        return self
+
+    def reference_layout(self) -> "_ModelWriter":
+        """Write the Scala implementation's on-disk shape (tuple-column
+        probabilities parquet, JVM class name) so Spark's reader
+        (LanguageDetectorModel.scala:60-105) can load the model. Exact
+        vocabs only."""
+        self._layout = "reference"
         return self
 
     def save(self, path: str) -> None:
@@ -434,4 +464,5 @@ class _ModelWriter:
             self._model.uid,
             self._model.param_metadata(),
             overwrite=self._overwrite,
+            layout=self._layout,
         )

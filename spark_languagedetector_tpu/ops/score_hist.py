@@ -1,0 +1,352 @@
+"""Row-histogram scoring: the n >= 3 accumulate as MXU matmuls, no row gather.
+
+The gather strategies (:mod:`ops.score`) resolve each window to a compact
+weight row, then gather that row — a [B, block, L] random-access read that is
+issue-bound on TPU (~10ns/row regardless of L or dtype; measured on v5e, see
+``exp_xla_gather.py`` history). This module replaces the gather+accumulate
+with a dense reformulation:
+
+    scores[b] = sum_w W[r_bw] = hist_b @ W,  hist_b[r] = #{w : r_bw == r}
+
+and computes ``hist_b`` over the compact row space R with the same
+digit-decomposition trick the bigram kernel uses for byte pairs
+(:mod:`ops.score_pallas`): split r = hi * 256 + lo, build lane-major one-hots
+of the hi and lo digits per window block, and accumulate their NT product
+
+    hist2d[hi, lo] += oh_hi [Rhi, blk] . oh_lo [256, blk]^T    (MXU)
+
+in VMEM scratch — fully dense work at R MACs/window, which beats the
+issue-bound gather whenever R is compact (profiles here: R ~ 45-70k, so
+~0.1-0.2us/window of MXU vs ~10ns+ of serialized gather issue... per *row*;
+the win is ~3-5x end-to-end on the n>=3 path). The final contraction
+``hist @ W`` runs as one XLA MXU matmul over the whole batch in HIGHEST
+precision (counts are exact f32 integers — same parity argument as
+``score_pallas._score_from_hist``).
+
+Membership stays in XLA (cuckoo probes / LUT gathers — 2 small gathers per
+window; in-kernel table gathers do not lower on Mosaic), masked or missing
+windows resolve to the zeros miss row, so the kernel needs no masks at all:
+miss counts multiply a zero weight row.
+
+Replaces the reference's per-window hash-map lookup + ``BLAS.axpy`` hot loop
+(``/root/reference/src/main/.../LanguageDetectorModel.scala:139-152``) at
+full gram depth (n = 1..5), where the one-hot byte factorization of
+:mod:`ops.score_pallas` stops at n = 2.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .score import _splice_partial_windows
+from .vocab import (
+    VocabSpec,
+    mix32,
+    partial_window_ids,
+    partial_window_keys,
+    window_ids,
+    window_keys,
+)
+
+# Documents per grid step (sublane tile height of the row planes).
+DB = 8
+
+# Window-axis block: lane dimension of the digit one-hots. The MXU
+# contraction depth is the block, so larger is better until the one-hot
+# operands crowd VMEM (oh_hi [Rhi, blk] bf16 = Rhi*blk*2 bytes); 2048
+# measured ~8% (Rhi=184) to ~35% (Rhi=280) faster than 1024 on v5e.
+DEFAULT_BLOCK = 2048
+
+
+def _build_kernel(KW: int, W: int, blk: int, Rhi: int):
+    """Histogram kernel over concatenated per-length row segments.
+
+    Inputs are [DB, KW] hi/lo digit planes (KW = k segments of width W, each
+    a multiple of blk) plus a per-doc conservative valid-window bound vmax
+    (segment-local: block at concat offset ``off`` covers segment-local
+    starts [off % W, off % W + blk)). A block whose segment-local start is
+    past vmax holds only miss windows for this doc and is skipped.
+    """
+    n_steps = KW // blk
+
+    def kernel(hi_ref, lo_ref, vmax_ref, o_ref, acc_ref):
+        base = pl.program_id(0) * DB
+        for d in range(DB):
+            dmax = vmax_ref[base + d]
+            acc_ref[:, :] = jnp.zeros((Rhi, 256), jnp.float32)
+            for k in range(n_steps):
+                off = k * blk
+                local = off % W  # segment-local start (static)
+
+                def step(off=off):
+                    hi = hi_ref[pl.dslice(d, 1), pl.dslice(off, blk)]
+                    lo = lo_ref[pl.dslice(d, 1), pl.dslice(off, blk)]
+                    iota_hi = jax.lax.broadcasted_iota(
+                        jnp.int32, (Rhi, blk), 0
+                    )
+                    iota_lo = jax.lax.broadcasted_iota(
+                        jnp.int32, (256, blk), 0
+                    )
+                    oh_hi = jnp.where(hi == iota_hi, 1.0, 0.0).astype(
+                        jnp.bfloat16
+                    )
+                    oh_lo = jnp.where(lo == iota_lo, 1.0, 0.0).astype(
+                        jnp.bfloat16
+                    )
+                    acc_ref[:, :] += jax.lax.dot_general(
+                        oh_hi, oh_lo, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+
+                pl.when(local < dmax)(step)
+            o_ref[pl.dslice(d * Rhi, Rhi), :] = acc_ref[:, :]
+
+    return kernel
+
+
+# Window-axis block for the scan around bucket gathers: each gathered
+# bucket row is 16 int32 lane-padded to 128 on TPU (8x), so a full-width
+# [B, W] gather materializes B*W*512 bytes — 12.9GB at [4096, 6144]. The
+# scan bounds the live temp to B*blk*512 (~2GB at the default batch).
+MEMBER_BLOCK = 1024
+
+
+def _bucket_decode(l, h_k, e, rows, kind: str):
+    """Fold one gathered bucket row [..., 16] into verified weight rows."""
+    from .bucket import HI_BITS, SLOTS
+
+    for s in range(SLOTS):
+        ek = e[..., s]
+        ep = e[..., SLOTS + s]
+        if kind == "exact":
+            hit = (ek == l) & ((ep & ((1 << HI_BITS) - 1)) == h_k)
+            row = ep >> HI_BITS
+        else:
+            hit = ek == l
+            row = ep
+        rows = jnp.where(hit, row, rows)
+    return rows
+
+
+def _bucket_rows(
+    lo: jnp.ndarray,
+    hi: jnp.ndarray,
+    table: jnp.ndarray,
+    miss: int,
+    seed: int,
+    kind: str,
+) -> jnp.ndarray:
+    """Single-probe verified bucket lookup (``ops.bucket.BucketTable``):
+    one [16]-int row gather per window + eight VPU compare/selects —
+    measured 1.6-2.2x the cuckoo probe pair on v5e. Scan-blocked along the
+    window axis to bound the lane-padded gather temporary."""
+    Mb = table.shape[0]
+    B, W = lo.shape
+    miss_rows = jnp.full((B, W), miss, jnp.int32)
+
+    def resolve(l, h_k, r):
+        hb = (mix32(l, h_k, seed, xp=jnp) & jnp.uint32(Mb - 1)).astype(
+            jnp.int32
+        )
+        return _bucket_decode(l, h_k, table[hb], r, kind)
+
+    if W <= MEMBER_BLOCK:
+        return resolve(lo, hi, miss_rows)
+    pad = (-W) % MEMBER_BLOCK
+    if pad:
+        lo = jnp.pad(lo, ((0, 0), (0, pad)))
+        hi = jnp.pad(hi, ((0, 0), (0, pad)))
+        miss_rows = jnp.pad(miss_rows, ((0, 0), (0, pad)),
+                            constant_values=miss)
+    nb = lo.shape[1] // MEMBER_BLOCK
+    blocks = tuple(
+        a.reshape(B, nb, MEMBER_BLOCK).transpose(1, 0, 2)
+        for a in (lo, hi, miss_rows)
+    )
+    _, rows = jax.lax.scan(
+        lambda carry, xs: (carry, resolve(*xs)), None, blocks
+    )
+    return rows.transpose(1, 0, 2).reshape(B, nb * MEMBER_BLOCK)[:, :W]
+
+
+def _hist_from_rows(
+    rows: jnp.ndarray,
+    vmax: jnp.ndarray,
+    W: int,
+    Rhi: int,
+    *,
+    block: int,
+    interpret: bool,
+) -> jnp.ndarray:
+    """float32 [B, Rhi*256] per-document row histograms.
+
+    ``rows`` is [B, KW] int32 compact row indices (miss windows already
+    pointing at a zeros weight row), KW a multiple of the segment width W,
+    W a multiple of ``block``.
+    """
+    B, KW = rows.shape
+    hi = (rows >> 8).astype(jnp.int32)
+    lo = (rows & 255).astype(jnp.int32)
+    out = pl.pallas_call(
+        _build_kernel(KW, W, block, Rhi),
+        grid=(B // DB,),
+        in_specs=[
+            pl.BlockSpec((DB, KW), lambda b: (b, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((DB, KW), lambda b: (b, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (DB * Rhi, 256), lambda b: (b, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * Rhi, 256), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((Rhi, 256), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)
+        ),
+        interpret=interpret,
+    )(hi, lo, vmax.astype(jnp.int32))
+    return out.reshape(B, Rhi * 256)
+
+
+def pad_weights(weights, rhi: int | None = None):
+    """Compact [G+1, L] table -> ([Rhi*256, L] f32 zero-padded, Rhi).
+
+    Rows past the table are never counted (no window resolves there), so
+    zero padding is semantically inert. Call once per profile, not per
+    batch. Rhi is rounded up to a sublane-friendly multiple of 8.
+    """
+    import numpy as np
+
+    R, L = weights.shape
+    if rhi is None:
+        ceil_hi = -(-R // 256)
+        rhi = -(-ceil_hi // 8) * 8
+    padded = np.zeros((rhi * 256, L), dtype=np.float32)
+    padded[:R] = np.asarray(weights, dtype=np.float32)
+    return padded, rhi
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "spec", "rhi", "block", "gram_lengths_subset", "interpret",
+        "bucket_seed", "bucket_kind",
+    ),
+)
+def score_batch_hist(
+    batch: jnp.ndarray,
+    lengths: jnp.ndarray,
+    weights_pad: jnp.ndarray,
+    lut: jnp.ndarray | None = None,
+    bucket: jnp.ndarray | None = None,
+    window_limit: jnp.ndarray | None = None,
+    *,
+    spec: VocabSpec,
+    rhi: int,
+    bucket_seed: int = 0,
+    bucket_kind: str = "exact",
+    block: int = DEFAULT_BLOCK,
+    gram_lengths_subset: tuple[int, ...] | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Histogram-strategy scores for a padded batch.
+
+    Same contract as :func:`ops.score.score_batch` /
+    :func:`ops.score.score_batch_cuckoo` (masking, Scala ``sliding``
+    partial-window rule, ``window_limit``, subset), with the weight table
+    pre-padded by :func:`pad_weights`. Membership is the single-probe
+    bucket table when ``bucket`` is given (``ops.bucket`` — preferred),
+    else the dense id->row ``lut`` (vocabs whose bucket build failed).
+
+    ``bucket_kind`` is the bucket table's key form (``BucketTable.kind``):
+    'exact' probes with packed gram keys (cuckoo-derived tables), 'hashed'
+    probes with int32 window ids (LUT-derived tables — including EXACT
+    vocabs with gram lengths <= 3, whose ids fit int32; the vocab mode does
+    NOT determine the key form).
+    """
+    if (lut is None) == (bucket is None):
+        raise ValueError("pass exactly one of bucket (preferred) or lut "
+                         "for membership")
+    kind = bucket_kind
+    B, S = batch.shape
+    miss = weights_pad.shape[0] - 1  # any zero row works; use the last
+    # The compact table's own miss row G is zero too, but rows arrive in
+    # [0, G]; masked windows are pointed at `miss` explicitly below.
+    lengths_to_score = (
+        gram_lengths_subset if gram_lengths_subset is not None
+        else spec.gram_lengths
+    )
+
+    segs = []
+    W = 0
+    for n in lengths_to_score:
+        W = max(W, S - n + 1 if S >= n else 1)
+    # Lane-clamp the block to the (128-aligned) segment width, then round
+    # the common segment width up to a whole number of blocks.
+    block = min(block, -(-W // 128) * 128)
+    W = -(-W // block) * block
+
+    for n in lengths_to_score:
+        if bucket is not None and kind == "exact":
+            lo_k, hi_k = window_keys(batch, n)
+            rows = _bucket_rows(lo_k, hi_k, bucket, miss, bucket_seed, kind)
+            plo, phi = partial_window_keys(batch, lengths, n)
+            partial_rows = _bucket_rows(
+                plo[:, None], phi[:, None], bucket, miss, bucket_seed, kind
+            )[:, 0]
+        elif bucket is not None:
+            ids = window_ids(batch, n, spec)
+            rows = _bucket_rows(
+                ids, jnp.zeros_like(ids), bucket, miss, bucket_seed, kind
+            )
+            pids = partial_window_ids(batch, lengths, n, ids[:, 0], spec)
+            partial_rows = _bucket_rows(
+                pids[:, None], jnp.zeros_like(pids)[:, None],
+                bucket, miss, bucket_seed, kind,
+            )[:, 0]
+        else:
+            ids = window_ids(batch, n, spec)
+            rows = lut[ids]
+            partial_rows = lut[
+                partial_window_ids(batch, lengths, n, ids[:, 0], spec)
+            ]
+        partial_rows = jnp.where(lengths > 0, partial_rows, miss)
+        rows, mask = _splice_partial_windows(
+            rows, partial_rows, lengths, n, window_limit
+        )
+        rows = jnp.where(mask, rows, miss)
+        pad = W - rows.shape[1]
+        if pad:
+            rows = jnp.pad(rows, ((0, 0), (0, pad)), constant_values=miss)
+        segs.append(rows)
+
+    rows_all = jnp.concatenate(segs, axis=1) if len(segs) > 1 else segs[0]
+
+    # Conservative per-doc valid-window bound, segment-local: every valid
+    # start is < min(len, limit), and the partial-window splice lives at
+    # start 0 (included whenever len > 0).
+    vmax = jnp.minimum(lengths, W).astype(jnp.int32)
+    if window_limit is not None:
+        vmax = jnp.minimum(vmax, window_limit.astype(jnp.int32))
+
+    B0 = B
+    if B % DB:
+        padB = DB - B % DB
+        rows_all = jnp.pad(
+            rows_all, ((0, padB), (0, 0)), constant_values=miss
+        )
+        vmax = jnp.pad(vmax, (0, padB))
+        B = B0 + padB
+
+    hist = _hist_from_rows(
+        rows_all, vmax, W, rhi, block=block, interpret=interpret
+    )
+    scores = jax.lax.dot(
+        hist, weights_pad, precision=jax.lax.Precision.HIGHEST
+    )
+    return scores[:B0]

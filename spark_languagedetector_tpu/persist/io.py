@@ -13,6 +13,15 @@ class name, uid, params, and the TPU-native extras the reference doesn't have
 (vocab mode, hash bits, weight mode). Hashed profiles have no gram bytes, so
 ``probabilities/`` stores bucket ids; the metadata records which flavor was
 written and the reader reconstructs accordingly.
+
+Cross-implementation interop: the reference's writer emits ``probabilities/``
+as a Spark ``Dataset[(Seq[Byte], Array[Double])]`` — tuple columns ``_1``
+(list<int8>, signed JVM bytes) and ``_2`` (list<double>)
+(LanguageDetectorModel.scala:37-43; reader :73-78) — under the Scala class
+name. :func:`load_model` reads BOTH layouts (column names decide), and
+``save_model(..., layout="reference")`` writes the Scala layout so a model
+trained here loads in the Spark implementation (exact vocabs only — the
+reference has no hashed mode).
 """
 
 from __future__ import annotations
@@ -31,6 +40,11 @@ from ..utils.logging import get_logger, log_event
 _log = get_logger("persist.io")
 
 _CLASS_NAME = "spark_languagedetector_tpu.models.estimator.LanguageDetectorModel"
+# The reference implementation's writer records its JVM class
+# (LanguageDetectorModel.scala:66 — DefaultParamsReader checks it on load).
+_SPARK_CLASS_NAME = (
+    "org.apache.spark.ml.feature.languagedetection.LanguageDetectorModel"
+)
 
 
 def _write_parquet(path: Path, table) -> None:
@@ -57,10 +71,25 @@ def save_model(
     uid: str,
     params: dict,
     overwrite: bool = True,
+    layout: str = "native",
 ) -> None:
-    """Write the model directory (SaveMode.Overwrite semantics)."""
+    """Write the model directory (SaveMode.Overwrite semantics).
+
+    ``layout="reference"`` writes the Scala implementation's exact on-disk
+    shape — tuple-column probabilities parquet under the JVM class name,
+    paramMap limited to the params the reference model declares
+    (HasInputCol/HasOutputCol) — so the Spark reader can load it. Exact
+    vocabs only: the reference has no hashed mode to round-trip into.
+    """
     import pyarrow as pa
 
+    if layout not in ("native", "reference"):
+        raise ValueError(f"unknown layout {layout!r}")
+    if layout == "reference" and profile.spec.mode != EXACT:
+        raise ValueError(
+            "layout='reference' requires an exact vocab — the reference "
+            "implementation stores gram bytes and has no hashed mode"
+        )
     root = Path(path)
     if root.exists():
         if not overwrite:
@@ -69,25 +98,63 @@ def save_model(
     root.mkdir(parents=True)
 
     # metadata/ — single JSON line, Spark DefaultParamsWriter-style fields.
-    meta = {
-        "class": _CLASS_NAME,
-        "timestamp": int(time.time() * 1000),
-        "uid": uid,
-        "paramMap": params,
-        "vocab": {
-            "mode": profile.spec.mode,
-            "gramLengths": list(profile.spec.gram_lengths),
-            "hashBits": profile.spec.hash_bits,
-            "hashScheme": profile.spec.hash_scheme,
-        },
-        "languages": list(profile.languages),
-    }
+    if layout == "reference":
+        # Flatten our nested Params metadata to Spark's flat paramMap,
+        # restricted to params the reference model declares
+        # (HasInputCol/HasOutputCol — LanguageDetectorModel.scala:183-184).
+        flat = {
+            **params.get("defaultParams", {}),
+            **params.get("params", {}),
+        }
+        meta = {
+            "class": _SPARK_CLASS_NAME,
+            "timestamp": int(time.time() * 1000),
+            "sparkVersion": "2.2.0",
+            "uid": uid,
+            "paramMap": {
+                k: v for k, v in flat.items()
+                if k in ("inputCol", "outputCol")
+            },
+        }
+    else:
+        meta = {
+            "class": _CLASS_NAME,
+            "timestamp": int(time.time() * 1000),
+            "uid": uid,
+            "paramMap": params,
+            "vocab": {
+                "mode": profile.spec.mode,
+                "gramLengths": list(profile.spec.gram_lengths),
+                "hashBits": profile.spec.hash_bits,
+                "hashScheme": profile.spec.hash_scheme,
+            },
+            "languages": list(profile.languages),
+        }
     meta_dir = root / "metadata"
     meta_dir.mkdir()
     (meta_dir / "part-00000").write_text(json.dumps(meta) + "\n")
 
     # probabilities/ — gram bytes (exact) or bucket ids (hashed) + weights.
-    if profile.spec.mode == EXACT:
+    if layout == "reference":
+        # Spark tuple encoding of Dataset[(Seq[Byte], Array[Double])]:
+        # _1 = list<int8> (JVM bytes are signed), _2 = list<double>.
+        grams = [profile.spec.id_to_gram(int(i)) for i in profile.ids]
+        prob_table = pa.table(
+            {
+                "_1": pa.array(
+                    [
+                        np.frombuffer(g, np.uint8).astype(np.int8).tolist()
+                        for g in grams
+                    ],
+                    type=pa.list_(pa.int8()),
+                ),
+                "_2": pa.array(
+                    [row.tolist() for row in profile.weights],
+                    type=pa.list_(pa.float64()),
+                ),
+            }
+        )
+    elif profile.spec.mode == EXACT:
         grams = [profile.spec.id_to_gram(int(i)) for i in profile.ids]
         prob_table = pa.table(
             {
@@ -132,9 +199,10 @@ def load_model(path: str | Path) -> tuple[GramProfile, str, dict]:
     root = Path(path)
     meta_file = root / "metadata" / "part-00000"
     meta = json.loads(meta_file.read_text().splitlines()[0])
-    if meta.get("class") != _CLASS_NAME:
+    if meta.get("class") not in (_CLASS_NAME, _SPARK_CLASS_NAME):
         raise ValueError(
-            f"metadata class mismatch: expected {_CLASS_NAME}, got {meta.get('class')}"
+            f"metadata class mismatch: expected {_CLASS_NAME} or "
+            f"{_SPARK_CLASS_NAME}, got {meta.get('class')}"
         )
 
     languages = tuple(
@@ -155,10 +223,26 @@ def load_model(path: str | Path) -> tuple[GramProfile, str, dict]:
     )
 
     prob = _read_parquet(root / "probabilities")
-    weights_rows = prob["probabilities"].to_pylist()
     L = len(languages)
+    if "_1" in prob.column_names:
+        # Reference tuple layout (Dataset[(Seq[Byte], Array[Double])]):
+        # _1 holds signed JVM bytes — wrap back to raw gram bytes.
+        if mode != EXACT:
+            raise ValueError(
+                "reference-layout probabilities imply an exact vocab, but "
+                f"metadata says mode={mode!r}"
+            )
+        grams = [
+            np.asarray(g, dtype=np.int8).astype(np.uint8).tobytes()
+            for g in prob["_1"].to_pylist()
+        ]
+        weights_rows = prob["_2"].to_pylist()
+    else:
+        grams = None
+        weights_rows = prob["probabilities"].to_pylist()
     if mode == EXACT:
-        grams = prob["gram"].to_pylist()
+        if grams is None:
+            grams = prob["gram"].to_pylist()
         pairs = sorted(
             ((spec.gram_to_id(bytes(g)), np.asarray(w, dtype=np.float64))
              for g, w in zip(grams, weights_rows)),
@@ -184,7 +268,12 @@ def load_model(path: str | Path) -> tuple[GramProfile, str, dict]:
         )
 
     profile = GramProfile(spec=spec, languages=languages, ids=ids, weights=weights)
-    return profile, meta["uid"], meta.get("paramMap", {})
+    params = meta.get("paramMap", {})
+    if meta.get("class") == _SPARK_CLASS_NAME:
+        # Spark's DefaultParamsWriter stores explicitly-set params as a flat
+        # name->value map; our Params metadata nests them under "params".
+        params = {"params": params}
+    return profile, meta["uid"], params
 
 
 def save_gram_dump(path: str | Path, profile: GramProfile) -> None:

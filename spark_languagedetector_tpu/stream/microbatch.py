@@ -98,6 +98,7 @@ def run_stream(
     max_batches: int | None = None,
     on_progress: Callable[[StreamingQuery], None] | None = None,
     prefetch: int = 0,
+    workers: int | None = None,
 ) -> StreamingQuery:
     """Drive the micro-batch loop: for each source batch, transform on the
     accelerator and hand the annotated table to the sink.
@@ -107,14 +108,19 @@ def run_stream(
     before propagating, covering transient device/tunnel hiccups.
 
     ``prefetch > 0`` overlaps batch N+1's transform with batch N's result
-    fetch and sink: transforms run on a single worker thread (so device
-    dispatch stays serialized), while sinks always run in the caller's
-    thread, in source order. Measured on a tunneled v5e the per-batch
-    blocking result fetch otherwise serializes the pipeline (~0.1s/batch of
-    dead time). Caveat: with a *consuming* source (e.g. Kafka with
-    auto-commit), an error that terminates the loop can discard up to
-    ``prefetch`` batches that were already pulled from the source but not
-    yet sunk — use the default ``prefetch=0`` when the source cannot replay.
+    fetch and sink; sinks always run in the caller's thread, in source
+    order. ``workers`` (default ``min(2, prefetch)``) is the transform
+    concurrency: with one worker, transforms serialize — batch N+1's
+    host->device transfer cannot start until batch N's result fetch
+    returns, which on a high-latency link (tunneled TPU here) leaves the
+    wire idle for the whole fetch round-trip. A second worker keeps the
+    wire busy during fetches (measured ~2x stream throughput on a wire-
+    bound model); batches stay independent, and the device executes queued
+    programs in order, so results are unchanged. Caveat: with a *consuming*
+    source (e.g. Kafka with auto-commit), an error that terminates the loop
+    can discard up to ``prefetch`` batches that were already pulled from
+    the source but not yet sunk — use the default ``prefetch=0`` when the
+    source cannot replay.
     """
     query = StreamingQuery()
     it = iter(source)
@@ -129,9 +135,10 @@ def run_stream(
             query.metrics.incr("retries")
             return model.transform(batch)
 
-    # Exactly one worker: device dispatch must stay serialized (JAX's async
-    # queue is the pipeline; a second dispatcher would interleave programs).
-    executor = ThreadPoolExecutor(max_workers=1) if prefetch > 0 else None
+    n_workers = workers if workers is not None else min(2, max(prefetch, 1))
+    executor = (
+        ThreadPoolExecutor(max_workers=n_workers) if prefetch > 0 else None
+    )
     in_flight: deque = deque()  # (batch, seq, future-or-None)
     seq = 0
     try:

@@ -1,0 +1,76 @@
+"""Single-probe bucket membership table (ops.bucket)."""
+
+import numpy as np
+
+from spark_languagedetector_tpu.ops.bucket import (
+    HI_BITS,
+    HI_SENTINEL,
+    SLOTS,
+    build_buckets_exact,
+    build_buckets_hashed,
+    lookup_numpy,
+)
+from spark_languagedetector_tpu.ops.cuckoo import build_cuckoo, lookup_numpy as cuckoo_lookup
+from spark_languagedetector_tpu.ops.vocab import gram_key
+
+RNG = np.random.default_rng(11)
+
+
+def _keys(n=5000):
+    grams = sorted(
+        {bytes(RNG.integers(97, 123, int(RNG.integers(1, 6))).tolist())
+         for _ in range(n)}
+    )
+    ks = [gram_key(g) for g in grams]
+    return (np.asarray([k[0] for k in ks], np.int32),
+            np.asarray([k[1] for k in ks], np.int32))
+
+
+def test_exact_build_and_lookup_matches_cuckoo():
+    lo, hi = _keys()
+    G = len(lo)
+    bt = build_buckets_exact(lo, hi)
+    assert bt is not None and bt.kind == "exact"
+    # every learned key resolves to its own row
+    got = lookup_numpy(bt, lo, hi, miss=G)
+    np.testing.assert_array_equal(got, np.arange(G))
+    # random probe keys agree with the cuckoo table's membership answer
+    ct = build_cuckoo(lo, hi)
+    qlo = np.concatenate([lo[:200], RNG.integers(-2**31, 2**31 - 1, 500).astype(np.int32)])
+    qhi = np.concatenate([hi[:200], RNG.integers(256, 1536, 500).astype(np.int32)])
+    np.testing.assert_array_equal(
+        lookup_numpy(bt, qlo, qhi, miss=G), cuckoo_lookup(ct, qlo, qhi)
+    )
+
+
+def test_exact_empty_slots_cannot_match():
+    lo, hi = _keys(100)
+    bt = build_buckets_exact(lo, hi)
+    empties = bt.rows[:, SLOTS:] == HI_SENTINEL
+    assert empties.any()
+    assert HI_SENTINEL > 1535  # larger than any real packed hi
+
+
+def test_hashed_build_and_lookup():
+    V = 1 << 16
+    ids = np.sort(RNG.choice(V, 3000, replace=False)).astype(np.int32)
+    rows = RNG.permutation(3000).astype(np.int32)
+    bt = build_buckets_hashed(ids, rows)
+    assert bt is not None and bt.kind == "hashed"
+    got = lookup_numpy(bt, ids, np.zeros_like(ids), miss=3000)
+    np.testing.assert_array_equal(got, rows)
+    # misses stay misses
+    others = np.setdiff1d(np.arange(V, dtype=np.int32), ids)[:500]
+    got = lookup_numpy(bt, others, np.zeros_like(others), miss=3000)
+    assert (got == 3000).all()
+
+
+def test_payload_packing_roundtrip():
+    lo, hi = _keys(2000)
+    G = len(lo)
+    bt = build_buckets_exact(lo, hi)
+    occupied = bt.rows[:, SLOTS:] != HI_SENTINEL
+    payloads = bt.rows[:, SLOTS:][occupied]
+    rows = payloads >> HI_BITS
+    assert rows.min() >= 0 and rows.max() < G
+    assert len(np.unique(rows)) == G  # every row placed exactly once

@@ -153,3 +153,120 @@ def test_writer_without_overwrite_refuses_existing_path(tmp_path):
     with pytest.raises(FileExistsError):
         m1.write().save(path)
     m1.write().overwrite().save(path)  # explicit overwrite: fine
+
+
+# ---------------------------------------------------------- interop ---------
+def _fit_small_model(vocab="exact"):
+    train = Table(
+        {
+            "lang": ["de", "de", "en", "en"],
+            "fulltext": [
+                "Dies ist ein deutscher Text, das ist ja sehr sch\u00f6n",
+                "Dies ist ein andere deutscher Text, der ist auch sch\u00f6n",
+                "This is a text in english, and that is very nice",
+                "This is another text in english and that is also nice",
+            ],
+        }
+    )
+    det = LanguageDetector(["de", "en"], [2, 3], 20)
+    if vocab == "hashed":
+        det = det.set_vocab_mode("hashed").set_hash_bits(12)
+    return det.fit(train)
+
+
+def _reference_layout_dir(tmp_path, gram_map, languages, gram_lengths):
+    """Hand-build a model directory exactly as the Scala writer lays it out
+    (LanguageDetectorModel.scala:28-58): tuple-column probabilities parquet
+    (signed JVM bytes), value-column languages/gramLengths, JVM metadata."""
+    import json
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    root = tmp_path / "scala_model"
+    (root / "metadata").mkdir(parents=True)
+    meta = {
+        "class": (
+            "org.apache.spark.ml.feature.languagedetection."
+            "LanguageDetectorModel"
+        ),
+        "timestamp": 1500000000000,
+        "sparkVersion": "2.2.0",
+        "uid": "LanguageDetectorModel_4a1b2c3d",
+        "paramMap": {"inputCol": "fulltext", "outputCol": "language"},
+    }
+    (root / "metadata" / "part-00000").write_text(json.dumps(meta) + "\n")
+    signed = [
+        np.frombuffer(g, np.uint8).astype(np.int8).tolist() for g in gram_map
+    ]
+    pq_dir = root / "probabilities"
+    pq_dir.mkdir()
+    pq.write_table(
+        pa.table({
+            "_1": pa.array(signed, type=pa.list_(pa.int8())),
+            "_2": pa.array(
+                [list(v) for v in gram_map.values()],
+                type=pa.list_(pa.float64()),
+            ),
+        }),
+        pq_dir / "part-00000-abc.snappy.parquet",
+    )
+    for sub, vals, typ in (
+        ("supportedLanguages", list(languages), pa.string()),
+        ("gramLengths", list(gram_lengths), pa.int32()),
+    ):
+        d = root / sub
+        d.mkdir()
+        pq.write_table(
+            pa.table({"value": pa.array(vals, type=typ)}),
+            d / "part-00000-abc.snappy.parquet",
+        )
+    return root
+
+
+def test_load_reference_layout_model(tmp_path):
+    """A model saved by the actual Scala implementation loads here: tuple
+    columns decode to gram bytes (signed-byte wrap included) and params
+    carry over."""
+    gram_map = {
+        b"Die": [1.0, 0.0],
+        b"Thi": [0.0, 1.0],
+        bytes([0xC3, 0xA9, 0x20]): [0.5, 0.25],  # high bytes -> signed JVM
+    }
+    root = _reference_layout_dir(tmp_path, gram_map, ["de", "en"], [3])
+    model = LanguageDetectorModel.load(str(root))
+    assert model.uid == "LanguageDetectorModel_4a1b2c3d"
+    assert model.get_output_col() == "language"
+    assert model.supported_languages == ("de", "en")
+    assert model.gram_lengths == (3,)
+    got = model.gram_probabilities
+    assert set(got) == set(gram_map)
+    for g, v in gram_map.items():
+        np.testing.assert_allclose(got[g], v)
+    out = model.transform(Table({"fulltext": ["Dies ist schön", "This is"]}))
+    assert list(out.column("language")) == ["de", "en"]
+
+
+def test_reference_layout_write_roundtrip(tmp_path):
+    """save in reference layout -> load back; the probabilities parquet
+    really carries the Scala tuple columns."""
+    import pyarrow.parquet as pq
+
+    model = _fit_small_model()
+    path = tmp_path / "interop"
+    model.write().overwrite().reference_layout().save(str(path))
+    cols = pq.read_table(
+        sorted((path / "probabilities").glob("*.parquet"))[0]
+    ).column_names
+    assert cols == ["_1", "_2"]
+    back = LanguageDetectorModel.load(str(path))
+    assert back.supported_languages == model.supported_languages
+    assert set(back.gram_probabilities) == set(model.gram_probabilities)
+
+
+def test_reference_layout_rejects_hashed(tmp_path):
+    model = _fit_small_model(vocab="hashed")
+    with pytest.raises(ValueError, match="exact"):
+        model.write().overwrite().reference_layout().save(
+            str(tmp_path / "nope")
+        )

@@ -1,0 +1,255 @@
+"""Row-histogram scorer (ops.score_hist) parity vs the gather scorers.
+
+The hist strategy must be bit-compatible in argmax and score-close (same
+counts, different summation order) with score_batch / score_batch_cuckoo
+across membership forms, partial windows, window limits, and subsets. Runs
+in pallas interpret mode on the CPU test substrate (tests/conftest.py); the
+Mosaic lowering is exercised by the opt-in real-TPU suite (test_tpu_hw).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_languagedetector_tpu.api.runner import BatchRunner
+from spark_languagedetector_tpu.models.profile import GramProfile
+from spark_languagedetector_tpu.ops import score as S
+from spark_languagedetector_tpu.ops import score_hist as SH
+from spark_languagedetector_tpu.ops.bucket import (
+    build_buckets_exact,
+    build_buckets_hashed,
+)
+from spark_languagedetector_tpu.ops.cuckoo import build_cuckoo
+from spark_languagedetector_tpu.ops.encoding import pad_batch
+from spark_languagedetector_tpu.ops.vocab import (
+    EXACT,
+    HASHED,
+    VocabSpec,
+    gram_key,
+)
+
+RNG = np.random.default_rng(7)
+L = 5
+
+
+def _random_docs(n, lo=97, hi=112, max_len=60):
+    docs = [
+        bytes(RNG.integers(lo, hi, RNG.integers(0, max_len)).tolist())
+        for _ in range(n)
+    ]
+    docs += [b"", b"a", b"ab", bytes(RNG.integers(0, 256, 200).tolist())]
+    return docs
+
+
+def _cuckoo_fixture(gram_lengths=(1, 2, 3, 4, 5), n_grams=400):
+    spec = VocabSpec(EXACT, gram_lengths)
+    grams = set()
+    while len(grams) < n_grams:
+        n = int(RNG.integers(min(gram_lengths), max(gram_lengths) + 1))
+        grams.add(bytes(RNG.integers(97, 110, n).tolist()))
+    grams = sorted(grams)
+    weights = np.zeros((len(grams) + 1, L), np.float32)
+    weights[:-1] = RNG.normal(size=(len(grams), L)).astype(np.float32)
+    keys = [gram_key(g) for g in grams]
+    table = build_cuckoo(
+        np.asarray([k[0] for k in keys], np.int32),
+        np.asarray([k[1] for k in keys], np.int32),
+    )
+    return spec, weights, table
+
+
+def _lut_fixture(gram_lengths=(1, 2, 3), bits=12, n_rows=150):
+    spec = VocabSpec(HASHED, gram_lengths, hash_bits=bits)
+    V = 1 << bits
+    lut = np.full(V, n_rows, np.int32)
+    learned = RNG.choice(V, n_rows, replace=False)
+    lut[learned] = np.arange(n_rows)
+    weights = np.zeros((n_rows + 1, L), np.float32)
+    weights[:-1] = RNG.normal(size=(n_rows, L)).astype(np.float32)
+    return spec, weights, jnp.asarray(lut)
+
+
+def _batch(docs, pad_to=256):
+    b, l = pad_batch(docs, pad_to)
+    return jnp.asarray(b), jnp.asarray(l)
+
+
+@pytest.mark.parametrize("subset", [None, (3, 4, 5)])
+def test_hist_matches_cuckoo_gather(subset):
+    spec, weights, table = _cuckoo_fixture()
+    batch, lengths = _batch(_random_docs(17))
+    entries = jnp.asarray(table.entries())
+    bt = build_buckets_exact(table.keys_lo[:-1], table.keys_hi[:-1])
+    ref = S.score_batch_cuckoo(
+        batch, lengths, jnp.asarray(weights), entries,
+        seed1=table.seed1, seed2=table.seed2, spec=spec,
+        gram_lengths_subset=subset,
+    )
+    wp, rhi = SH.pad_weights(weights)
+    got = SH.score_batch_hist(
+        batch, lengths, jnp.asarray(wp), bucket=jnp.asarray(bt.rows),
+        bucket_seed=bt.seed, spec=spec, rhi=rhi,
+        gram_lengths_subset=subset, interpret=True, block=128,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-3)
+
+
+def test_hist_respects_window_limit():
+    spec, weights, table = _cuckoo_fixture()
+    docs = _random_docs(9)
+    batch, lengths = _batch(docs)
+    entries = jnp.asarray(table.entries())
+    bt = build_buckets_exact(table.keys_lo[:-1], table.keys_hi[:-1])
+    limit = jnp.asarray(RNG.integers(1, 40, len(docs)).astype(np.int32))
+    ref = S.score_batch_cuckoo(
+        batch, lengths, jnp.asarray(weights), entries,
+        seed1=table.seed1, seed2=table.seed2, spec=spec, window_limit=limit,
+    )
+    wp, rhi = SH.pad_weights(weights)
+    got = SH.score_batch_hist(
+        batch, lengths, jnp.asarray(wp), bucket=jnp.asarray(bt.rows),
+        bucket_seed=bt.seed, window_limit=limit, spec=spec, rhi=rhi,
+        interpret=True, block=128,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-3)
+
+
+def test_hist_matches_lut_gather_hashed():
+    spec, weights, lut = _lut_fixture()
+    batch, lengths = _batch(_random_docs(13))
+    ref = S.score_batch(batch, lengths, jnp.asarray(weights), lut, spec=spec)
+    wp, rhi = SH.pad_weights(weights)
+    got = SH.score_batch_hist(
+        batch, lengths, jnp.asarray(wp), lut=lut, spec=spec, rhi=rhi,
+        interpret=True, block=128,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-3)
+
+
+def test_hist_bucket_matches_lut_gather_hashed():
+    """Hashed vocab through the single-probe bucket membership."""
+    spec, weights, lut = _lut_fixture()
+    lut_np = np.asarray(lut)
+    miss = weights.shape[0] - 1
+    ids = np.nonzero(lut_np != miss)[0].astype(np.int32)
+    bt = build_buckets_hashed(ids, lut_np[ids])
+    batch, lengths = _batch(_random_docs(13))
+    ref = S.score_batch(batch, lengths, jnp.asarray(weights), lut, spec=spec)
+    wp, rhi = SH.pad_weights(weights)
+    got = SH.score_batch_hist(
+        batch, lengths, jnp.asarray(wp), bucket=jnp.asarray(bt.rows),
+        bucket_seed=bt.seed, bucket_kind=bt.kind, spec=spec, rhi=rhi,
+        interpret=True, block=128,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-3)
+
+
+def test_hist_requires_exactly_one_membership():
+    spec, weights, lut = _lut_fixture()
+    batch, lengths = _batch([b"abc"])
+    wp, rhi = SH.pad_weights(weights)
+    with pytest.raises(ValueError, match="exactly one"):
+        SH.score_batch_hist(
+            batch, lengths, jnp.asarray(wp), spec=spec, rhi=rhi,
+            interpret=True,
+        )
+
+
+def test_pad_weights_shapes():
+    w = np.ones((45241, 50), np.float32)
+    wp, rhi = SH.pad_weights(w)
+    assert rhi == 184 and wp.shape == (184 * 256, 50)
+    np.testing.assert_array_equal(wp[:45241], w)
+    assert not wp[45241:].any()
+
+
+def test_runner_hist_strategy_matches_gather():
+    """End-to-end through BatchRunner: strategy='hist' (interpret on CPU)
+    vs strategy='gather' on the same cuckoo profile, incl. long-doc
+    chunking (window limits through the public scoring path)."""
+    spec, weights, table = _cuckoo_fixture()
+    docs = _random_docs(11) + [bytes(b"abcde" * 300)]  # forces chunking
+    ref = BatchRunner(
+        weights=jnp.asarray(weights), lut=None, spec=spec,
+        cuckoo=table, strategy="gather", length_buckets=(128, 512),
+    ).score(docs)
+    got = BatchRunner(
+        weights=jnp.asarray(weights), lut=None, spec=spec,
+        cuckoo=table, strategy="hist", length_buckets=(128, 512),
+    ).score(docs)
+    np.testing.assert_allclose(got, ref, atol=1e-3)
+
+
+def test_runner_hist_requires_membership():
+    spec = VocabSpec(EXACT, (1, 2))
+    w = np.zeros((spec.id_space_size, L), np.float32)
+    with pytest.raises(ValueError, match="hist"):
+        BatchRunner(
+            weights=jnp.asarray(w), lut=None, spec=spec, strategy="hist"
+        )
+
+
+def test_hist_bucket_scan_blocked_membership(monkeypatch):
+    """Wide batches resolve membership through the window-axis scan
+    (MEMBER_BLOCK shrunk so the scan path runs at test sizes)."""
+    monkeypatch.setattr(SH, "MEMBER_BLOCK", 64)
+    spec, weights, table = _cuckoo_fixture()
+    batch, lengths = _batch(_random_docs(9))
+    entries = jnp.asarray(table.entries())
+    bt = build_buckets_exact(table.keys_lo[:-1], table.keys_hi[:-1])
+    ref = S.score_batch_cuckoo(
+        batch, lengths, jnp.asarray(weights), entries,
+        seed1=table.seed1, seed2=table.seed2, spec=spec,
+    )
+    wp, rhi = SH.pad_weights(weights)
+    got = SH.score_batch_hist(
+        batch, lengths, jnp.asarray(wp), bucket=jnp.asarray(bt.rows),
+        bucket_seed=bt.seed, spec=spec, rhi=rhi,
+        interpret=True, block=128,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-3)
+
+
+def test_runner_hist_exact_lut_profile_matches_gather():
+    """Regression: an EXACT vocab with gram lengths <= 3 ships LUT
+    membership — its bucket table is id-keyed ('hashed' kind) even though
+    the vocab mode is exact. Probing it with packed gram keys scored
+    everything zero."""
+    from spark_languagedetector_tpu.models.profile import GramProfile
+
+    gm = {}
+    while len(gm) < 120:
+        n = int(RNG.integers(1, 4))
+        gm[bytes(RNG.integers(97, 110, n).tolist())] = RNG.normal(size=L)
+    profile = GramProfile.from_gram_map(gm, tuple("abcde"), (1, 2, 3))
+    weights, lut, cuckoo = profile.device_membership()
+    assert lut is not None and cuckoo is None
+    docs = list(gm)[:40] + [b"abcabcghi", b"", b"a", bytes(range(250, 256))]
+    ref = BatchRunner(
+        weights=weights, lut=lut, spec=profile.spec, strategy="gather",
+        length_buckets=(128, 256),
+    ).score(docs)
+    got = BatchRunner(
+        weights=weights, lut=lut, spec=profile.spec, strategy="hist",
+        length_buckets=(128, 256),
+    ).score(docs)
+    assert np.abs(ref).max() > 0  # fixture actually hits
+    np.testing.assert_allclose(got, ref, atol=1e-3)
+
+
+def test_runner_explicit_gather_never_reroutes(monkeypatch):
+    """strategy='gather' is the escape hatch: it must not silently route
+    into the hist path even where hist is supported."""
+    spec, weights, table = _cuckoo_fixture()
+    r = BatchRunner(
+        weights=jnp.asarray(weights), lut=None, spec=spec,
+        cuckoo=table, strategy="gather", length_buckets=(128,),
+    )
+    called = {"hist": False}
+    monkeypatch.setattr(
+        r, "_hist_scores",
+        lambda *a, **k: called.__setitem__("hist", True),
+    )
+    r.score([b"abcdefgh"])
+    assert not called["hist"]

@@ -138,3 +138,18 @@ def test_stream_prefetch_retry_still_works():
 def test_kafka_source_gated_on_missing_dependency():
     with pytest.raises(RuntimeError, match="kafka-python"):
         next(kafka_source("topic", 10))
+
+
+def test_stream_explicit_single_worker_preserves_order():
+    """workers=1 forces serial transforms (the conservative pipeline)."""
+    rows = [{"fulltext": "ababab"}, {"fulltext": "xyxy"}] * 10
+    outputs = []
+    query = run_stream(
+        _model(),
+        memory_source(rows, batch_rows=4),
+        sink=lambda t: outputs.extend(t.column("lang").tolist()),
+        prefetch=3,
+        workers=1,
+    )
+    assert query.batches == 5
+    assert outputs == ["a", "x"] * 10

@@ -94,21 +94,35 @@ def resolve_device(backend: str):
     )
 
 
-def resolve_mesh(backend: str):
+def resolve_mesh(backend: str, table_bytes: int | None = None):
     """Device mesh for a backend param value, or None for single-device.
 
     'mesh' always builds a data-parallel mesh over every visible device of
     the preferred platform (accelerators when present, else host CPUs —
-    e.g. the 8-virtual-device test substrate). 'auto' builds one only when
-    MORE than one accelerator is visible, so single-chip and CPU-test
-    behavior keep the simple single-device dispatch path. The reference's
-    ``transform`` is cluster-parallel by default
-    (LanguageDetectorModel.scala:219-240 — ``Dataset.map`` over partitions);
-    this is that default, TPU-native.
+    e.g. the 8-virtual-device test substrate). 'mesh:vocab' additionally
+    carves a vocab axis so the dense weight table shards across devices
+    instead of replicating: the axis is sized to the smallest power of two
+    whose per-shard table fits the single-device replication budget
+    (``table_bytes`` hint; 2 when unknown), the rest stays data-parallel.
+    'auto' builds a mesh only when MORE than one accelerator is visible, so
+    single-chip and CPU-test behavior keep the simple single-device
+    dispatch path. The reference's ``transform`` is cluster-parallel by
+    default (LanguageDetectorModel.scala:219-240 — ``Dataset.map`` over
+    partitions); this is that default, TPU-native.
     """
+    from ..models.profile import DENSE_TABLE_BUDGET_BYTES
     from ..parallel.mesh import build_mesh
 
     accel = [d for d in jax.devices() if d.platform != "cpu"]
+    if backend == "mesh:vocab":
+        devices = accel or jax.devices("cpu")
+        n = len(devices)
+        vocab = 2
+        if table_bytes is not None:
+            while vocab * 2 <= n and table_bytes / vocab > DENSE_TABLE_BUDGET_BYTES:
+                vocab *= 2
+        vocab = min(vocab, n)
+        return build_mesh(data=n // vocab, vocab=vocab, devices=devices)
     if backend == "mesh":
         devices = accel or jax.devices("cpu")
         return build_mesh(data=len(devices), vocab=1, devices=devices)
@@ -170,16 +184,36 @@ class BatchRunner:
         if self.mesh is not None:
             if self.device is not None:
                 raise ValueError("pass either device or mesh, not both")
-            from ..parallel.mesh import DATA_AXIS, replicated
+            from ..parallel.mesh import (
+                DATA_AXIS,
+                VOCAB_AXIS,
+                replicated,
+                vocab_sharding,
+            )
 
             self._ndata = int(self.mesh.shape[DATA_AXIS])
             placement = replicated(self.mesh)
-        else:
-            placement = self.device
-        if placement is not None:
-            self.weights = jax.device_put(self.weights, placement)
+            # A mesh with a vocab axis shards the dense weight table across
+            # devices row-wise instead of replicating ~O(V*L) bytes per
+            # device; GSPMD turns the row gather into local gather + psum.
+            # Only the dense direct-indexed table shards (its row count is
+            # the pow2 id space); LUT/compact forms stay replicated.
+            w_placement = placement
+            if (
+                int(self.mesh.shape[VOCAB_AXIS]) > 1
+                and self.lut is None
+                and self.weights.shape[0] == self.spec.id_space_size
+            ):
+                w_placement = vocab_sharding(self.mesh)
+            self.weights = jax.device_put(self.weights, w_placement)
             if self.lut is not None:
                 self.lut = jax.device_put(self.lut, placement)
+        else:
+            placement = self.device
+            if placement is not None:
+                self.weights = jax.device_put(self.weights, placement)
+                if self.lut is not None:
+                    self.lut = jax.device_put(self.lut, placement)
         if self.cuckoo is not None:
             entries = jnp.asarray(self.cuckoo.entries())
             if placement is not None:

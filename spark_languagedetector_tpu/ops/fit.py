@@ -28,7 +28,7 @@ from typing import Sequence
 import numpy as np
 
 from .encoding import pad_batch
-from .vocab import EXACT, VocabSpec, short_doc_ids_numpy, window_ids_numpy
+from .vocab import EXACT, VocabSpec, window_ids_numpy
 
 PARITY = "parity"
 COUNTS = "counts"
@@ -57,15 +57,28 @@ def extract_gram_counts(
     num_langs: int,
     spec: VocabSpec,
     batch_size: int = _FIT_BATCH,
+    gram_lengths_subset: tuple[int, ...] | None = None,
+    min_partial_gram_len: int = 1,
 ) -> GramCounts:
     """Count every window occurrence per (gram id, language).
 
     One padded-batch sweep over the corpus; all languages aggregate in a single
     pass (the reference launches per-language Spark jobs — Q9). Partial windows
     of short documents are included, mirroring Scala ``sliding``.
+
+    ``gram_lengths_subset`` counts only those window classes (ids stay in the
+    full spec's id space); ``min_partial_gram_len`` additionally drops partial
+    windows whose *gram* (the whole short doc) is shorter than the bound. The
+    split device fit uses both to partition contributions by resulting gram
+    length with no overlap (``ops.fit_tpu.fit_profile_device_split``).
     """
     lang_indices = np.asarray(lang_indices, dtype=np.int64)
-    max_n = max(spec.gram_lengths)
+    lengths_to_count = (
+        tuple(gram_lengths_subset)
+        if gram_lengths_subset is not None
+        else spec.gram_lengths
+    )
+    max_n = max(lengths_to_count)
 
     # Streaming reduction with bounded memory (the reference streams this
     # through Spark shuffles, LanguageDetector.scala:52-66): each batch's
@@ -97,19 +110,26 @@ def extract_gram_counts(
         langs = lang_indices[start : start + batch_size]
         batch, lengths = pad_batch(docs, pad_to=max(max(len(d) for d in docs), 1))
         batch_chunks: list[np.ndarray] = []
-        for n in spec.gram_lengths:
+        for n in lengths_to_count:
+            if batch.shape[1] < n:
+                continue  # no full windows of this class in the batch
             ids = window_ids_numpy(batch, n, spec)  # [B, W]
             W = ids.shape[1]
             mask = np.arange(W)[None, :] <= (lengths[:, None] - n)
             lang_grid = np.broadcast_to(langs[:, None], ids.shape)
             batch_chunks.append(ids[mask] * num_langs + lang_grid[mask])
-        # Partial windows for docs shorter than some gram length.
+        # Partial windows for docs shorter than some gram length: one window
+        # of the whole doc per class it falls short of (Scala ``sliding``),
+        # id in the doc's own length class.
         for i, doc in enumerate(docs):
-            if len(doc) < max_n:
-                short = short_doc_ids_numpy(doc, spec)
-                if short:
+            n_doc = len(doc)
+            if min_partial_gram_len <= n_doc < max_n:
+                reps = sum(1 for n in lengths_to_count if n > n_doc)
+                if reps:
+                    short_id = spec.gram_to_id(bytes(doc))
                     batch_chunks.append(
-                        np.asarray(short, dtype=np.int64) * num_langs + langs[i]
+                        np.full(reps, short_id, dtype=np.int64) * num_langs
+                        + langs[i]
                     )
         if batch_chunks:
             u, c = np.unique(np.concatenate(batch_chunks), return_counts=True)

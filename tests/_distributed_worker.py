@@ -1,0 +1,73 @@
+"""Worker for the two-process jax.distributed test (test_resilience.py).
+
+Run as: python tests/_distributed_worker.py <coordinator> <n_procs> <pid>
+
+Each process pins JAX to CPU with two virtual devices, joins the
+coordination service through the framework's own ``parallel.distributed``
+entry points, then runs a real cross-process computation: host-sharded
+rows assembled into one globally-sharded array, reduced under jit (XLA
+inserts the cross-process collective), verified against the full-data
+answer on every process.
+"""
+import os
+import sys
+
+# Two local CPU devices per process -> a 4-device global mesh across the
+# two processes. Must be set before the backend initializes.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=2"
+)
+
+import jax  # noqa: E402
+
+# The axon sitecustomize force-sets jax_platforms programmatically; the
+# programmatic update below (not the env var) is what actually wins.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    coordinator, n_procs, pid = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    )
+    from spark_languagedetector_tpu.parallel import distributed as D
+    from spark_languagedetector_tpu.parallel.mesh import (
+        batch_sharding,
+        build_mesh,
+    )
+
+    D.initialize(
+        coordinator_address=coordinator,
+        num_processes=n_procs,
+        process_id=pid,
+    )
+    assert jax.process_count() == n_procs, jax.process_count()
+    assert jax.process_index() == pid
+    assert len(jax.devices()) == 2 * n_procs  # global device view
+
+    mesh = build_mesh(data=2 * n_procs, vocab=1)
+    rows, cols = 4 * n_procs, 3
+    full = np.arange(rows * cols, dtype=np.float32).reshape(rows, cols)
+    shard = D.host_shard(rows)
+    local = full[shard]
+    garr = D.global_batch(local, batch_sharding(mesh))
+    assert garr.shape == (rows, cols)
+
+    # Cross-process reduction: every process must see the full-data sum.
+    total = float(jax.jit(lambda x: x.sum())(garr))
+    expect = float(full.sum())
+    assert total == expect, (total, expect)
+
+    # Weighted reduction exercises a non-trivial collective too.
+    w = np.linspace(0.5, 1.5, cols).astype(np.float32)
+    got = float(jax.jit(lambda x: (x @ w).sum())(garr))
+    expect2 = float((full @ w).sum())
+    assert abs(got - expect2) < 1e-3, (got, expect2)
+
+    print(f"DIST_OK pid={pid} total={total}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

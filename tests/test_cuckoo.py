@@ -129,14 +129,23 @@ def test_exact_1to5_runner_uses_cuckoo_membership():
     assert runner.lut is None
 
 
-def test_exact_long_grams_reject_device_fit():
-    det = (
-        LanguageDetector(["de", "en"], [1, 4], 20)
-        .set_vocab_mode("exact")
-        .set_fit_backend("device")
-    )
-    with pytest.raises(ValueError, match="device"):
-        det.fit(Table({"lang": ["de", "en"], "fulltext": ["aaa bbb", "ccc ddd"]}))
+def test_exact_long_grams_device_fit_matches_host():
+    """Exact long-gram vocabs fit on device via the split path (device
+    counts for gram lengths <= 3, exact host counting for the rest) —
+    round 2 rejected this combination outright."""
+    rows = Table({"lang": ["de", "en"], "fulltext": ["aaa bbb", "ccc ddd"]})
+
+    def fit(backend):
+        return (
+            LanguageDetector(["de", "en"], [1, 4], 20)
+            .set_vocab_mode("exact")
+            .set_fit_backend(backend)
+            .fit(rows)
+        )
+
+    host, dev = fit("cpu"), fit("device")
+    np.testing.assert_array_equal(dev.profile.ids, host.profile.ids)
+    np.testing.assert_allclose(dev.profile.weights, host.profile.weights)
 
 
 def test_score_batch_cuckoo_window_limit():

@@ -161,3 +161,65 @@ def test_mesh_hybrid_strategy_matches_single_device(eight_devices):
         mesh=resolve_mesh("mesh"),
     ).score(docs)
     np.testing.assert_allclose(single, meshed, rtol=1e-4, atol=1e-3)
+
+
+# ------------------------------------------------ vocab sharding (r2 #8) ----
+def test_resolve_mesh_vocab_axis(eight_devices):
+    mesh = resolve_mesh("mesh:vocab")
+    assert mesh.shape["vocab"] == 2 and mesh.shape["data"] == 4
+    # Axis grows until the per-shard table fits the replication budget.
+    big = 8 * 256 * 1024 * 1024  # 8x the budget -> vocab axis = 8
+    mesh = resolve_mesh("mesh:vocab", table_bytes=big)
+    assert mesh.shape["vocab"] == 8 and mesh.shape["data"] == 1
+
+
+def test_vocab_sharded_scores_bit_match_replicated(eight_devices):
+    """Dense hashed table sharded over the vocab axis scores bit-identically
+    to the replicated mesh (GSPMD local-gather + psum vs plain gather)."""
+    from spark_languagedetector_tpu.ops.vocab import HASHED, VocabSpec
+
+    rng = np.random.default_rng(5)
+    spec = VocabSpec(HASHED, (1, 2, 3), hash_bits=12)
+    V, L = spec.id_space_size, 5
+    weights = rng.normal(size=(V, L)).astype(np.float32)
+    docs = [
+        bytes(rng.integers(97, 122, rng.integers(0, 120)).tolist())
+        for _ in range(19)
+    ] + [b""]
+
+    rep = BatchRunner(
+        weights=weights, lut=None, spec=spec,
+        mesh=resolve_mesh("mesh"), length_buckets=(64, 128),
+    )
+    shard = BatchRunner(
+        weights=weights, lut=None, spec=spec,
+        mesh=resolve_mesh("mesh:vocab"), length_buckets=(64, 128),
+    )
+    assert "vocab" in str(shard.weights.sharding.spec)
+    np.testing.assert_array_equal(shard.score(docs), rep.score(docs))
+
+
+def test_public_api_mesh_vocab_backend(eight_devices):
+    """set_backend('mesh:vocab') is reachable end-to-end and label-identical
+    to the replicated mesh backend."""
+    model = _fit()
+    model.set_backend("mesh")
+    want = model.transform(Table({"fulltext": EVAL})).column("lang").tolist()
+    model.set_backend("mesh:vocab")
+    runner = model._get_runner()
+    assert runner.mesh is not None and runner.mesh.shape["vocab"] == 2
+    got = model.transform(Table({"fulltext": EVAL})).column("lang").tolist()
+    assert got == want
+
+
+def test_mesh_vocab_falls_back_for_compact_profiles(eight_devices):
+    """A cuckoo/LUT profile can't vocab-shard: 'mesh:vocab' must keep the
+    full data axis instead of carving a useless vocab axis (which would
+    duplicate compute on every device)."""
+    det = LanguageDetector(LANGS, [1, 2, 3, 4, 5], 100)
+    model = det.set_vocab_mode("exact").fit(Table(ROWS))
+    model.set_backend("mesh:vocab")
+    runner = model._get_runner()
+    assert runner.cuckoo is not None  # compact membership form
+    assert runner.mesh.shape["vocab"] == 1
+    assert runner.mesh.shape["data"] == len(eight_devices)

@@ -289,16 +289,20 @@ def run_config(num: int) -> dict:
         rows = [{"fulltext": t} for t in eval_docs]
         sink_rows = []
         run_stream(  # warmup: compile every shape outside the timed window
-            model, memory_source(rows, 4096), lambda t: None, prefetch=3
+            model, memory_source(rows, 4096), lambda t: None,
+            prefetch=6, workers=4,
         )
         times = []
         # Streaming is transfer-bound like the other short-gram configs:
-        # same extra-pass rule. prefetch=3 keeps the wire busy across
-        # batches (two transform workers overlap transfer with fetch).
+        # same extra-pass rule. Four transform workers with a deep prefetch
+        # keep the bursty wire saturated across batches (A/B on the
+        # tunneled v5e: w2/p3 11.3k, w4/p6 24.9-25.2k rows/s in the same
+        # window; w6+/deeper plateaus).
         for _ in range(5 if max(cfg["gram_lengths"]) <= 3 else 3):
             t0 = time.perf_counter()
             q = run_stream(
-                model, memory_source(rows, 4096), sink_rows.append, prefetch=3
+                model, memory_source(rows, 4096), sink_rows.append,
+                prefetch=6, workers=4,
             )
             times.append(time.perf_counter() - t0)
             sink_rows.clear()

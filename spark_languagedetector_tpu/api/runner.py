@@ -276,7 +276,7 @@ class BatchRunner:
         if self.strategy == "hist" and not self._hist_supported():
             raise ValueError(
                 "strategy='hist' needs compact-row membership (a cuckoo "
-                "table or an id->row LUT) and no mesh"
+                "table or an id->row LUT)"
             )
         if self.batch_size is None:
             if self.strategy == "pallas":
@@ -472,9 +472,11 @@ class BatchRunner:
         """True when the row-histogram strategy applies: every window can be
         resolved to a compact weight row (a single-probe bucket table built
         from the cuckoo keys or the id->row LUT; hashed vocabs keep the LUT
-        itself as membership when no zero-overflow bucket seed exists).
-        Mesh dispatch keeps the GSPMD-partitioned gather path for now."""
-        return self.mesh is None and self._hist_state() is not None
+        itself as membership when no zero-overflow bucket seed exists). On a
+        mesh the scorer runs per data shard under shard_map with the tables
+        replicated (vocab-sharded dense tables keep the GSPMD gather path —
+        they have no compact membership)."""
+        return self._hist_state() is not None
 
     def _hist_state(self):
         """(weights_pad_dev, rhi, interpret, bucket_dev, bucket_seed, kind)
@@ -518,10 +520,16 @@ class BatchRunner:
         wp, rhi = score_hist.pad_weights(np.asarray(self.weights))
         wp = jnp.asarray(wp)
         bucket_dev = None if table is None else jnp.asarray(table.rows)
-        if self.device is not None:
-            wp = jax.device_put(wp, self.device)
+        if self.mesh is not None:
+            from ..parallel.mesh import replicated
+
+            placement = replicated(self.mesh)
+        else:
+            placement = self.device
+        if placement is not None:
+            wp = jax.device_put(wp, placement)
             if bucket_dev is not None:
-                bucket_dev = jax.device_put(bucket_dev, self.device)
+                bucket_dev = jax.device_put(bucket_dev, placement)
         interpret = self._target_device().platform != "tpu"
         state = self._hist_cache = (
             wp, rhi, interpret, bucket_dev,
@@ -530,16 +538,70 @@ class BatchRunner:
         )
         return state
 
+    def _mesh_hist_fn(self, gram_lengths_subset):
+        """shard_map wrapper running the hist scorer on each data shard
+        (the pallas hist kernel has no GSPMD partitioning rule; tables are
+        replicated, the batch splits over the data axis)."""
+        cache = getattr(self, "_mesh_hist_cache", None)
+        if cache is None:
+            cache = self._mesh_hist_cache = {}
+        fn = cache.get(gram_lengths_subset)
+        if fn is None:
+            from jax.sharding import PartitionSpec as P
+
+            from ..parallel.mesh import DATA_AXIS
+
+            wp, rhi, interpret, bucket_dev, bucket_seed, kind = (
+                self._hist_state()
+            )
+            has_bucket = bucket_dev is not None
+
+            def local(batch, lengths, member, lim):
+                return score_hist.score_batch_hist(
+                    batch, lengths, wp,
+                    lut=None if has_bucket else member,
+                    bucket=member if has_bucket else None,
+                    window_limit=lim,
+                    spec=self.spec,
+                    rhi=rhi,
+                    bucket_seed=bucket_seed,
+                    bucket_kind=kind,
+                    gram_lengths_subset=gram_lengths_subset,
+                    interpret=interpret,
+                )
+
+            fn = cache[gram_lengths_subset] = jax.jit(
+                jax.shard_map(
+                    local,
+                    mesh=self.mesh,
+                    in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P(DATA_AXIS)),
+                    out_specs=P(DATA_AXIS),
+                    check_vma=False,
+                )
+            )
+        return fn
+
     def _hist_scores(self, batch, lengths, window_limit, gram_lengths_subset):
         """Row-histogram scoring (ops.score_hist): single-probe bucket (or
         LUT) membership resolves rows, a pallas kernel builds per-doc row
         histograms on the MXU, one batch matmul contracts them with the
-        weight table."""
+        weight table. On a mesh the whole scorer runs per data shard under
+        shard_map."""
         wp, rhi, interpret, bucket_dev, bucket_seed, kind = self._hist_state()
+        has_bucket = bucket_dev is not None
+        member = bucket_dev if has_bucket else self.lut
+        if self.mesh is not None:
+            from ..parallel.mesh import batch_sharding
+
+            if window_limit is None:
+                window_limit = self._full_limit(
+                    batch.shape[0], batch_sharding(self.mesh)
+                )
+            return self._mesh_hist_fn(gram_lengths_subset)(
+                batch, lengths, member, window_limit
+            )
         return score_hist.score_batch_hist(
             batch, lengths, wp,
-            lut=None if bucket_dev is not None else self.lut,
-            bucket=bucket_dev,
             window_limit=window_limit,
             spec=self.spec,
             rhi=rhi,
@@ -547,6 +609,7 @@ class BatchRunner:
             bucket_kind=kind,
             gram_lengths_subset=gram_lengths_subset,
             interpret=interpret,
+            **{"bucket" if has_bucket else "lut": member},
         )
 
     def _gather_scores(

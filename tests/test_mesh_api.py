@@ -223,3 +223,40 @@ def test_mesh_vocab_falls_back_for_compact_profiles(eight_devices):
     assert runner.cuckoo is not None  # compact membership form
     assert runner.mesh.shape["vocab"] == 1
     assert runner.mesh.shape["data"] == len(eight_devices)
+
+
+def test_mesh_hist_strategy_matches_single_device(eight_devices):
+    """strategy='hist' under a data-parallel mesh (shard_map around the
+    pallas hist kernel) bit-matches the single-device gather scorer."""
+    from spark_languagedetector_tpu.ops.cuckoo import build_cuckoo
+    from spark_languagedetector_tpu.ops.vocab import EXACT, VocabSpec, gram_key
+
+    rng = np.random.default_rng(23)
+    spec = VocabSpec(EXACT, (1, 2, 3, 4, 5))
+    grams = sorted(
+        {bytes(rng.integers(97, 110, int(rng.integers(1, 6))).tolist())
+         for _ in range(300)}
+    )
+    L = 6
+    weights = np.zeros((len(grams) + 1, L), np.float32)
+    weights[:-1] = rng.normal(size=(len(grams), L)).astype(np.float32)
+    keys = [gram_key(g) for g in grams]
+    cuckoo = build_cuckoo(
+        np.asarray([k[0] for k in keys], np.int32),
+        np.asarray([k[1] for k in keys], np.int32),
+    )
+    docs = [
+        bytes(rng.integers(97, 112, rng.integers(0, 100)).tolist())
+        for _ in range(21)
+    ] + [b"", b"ab", bytes(b"abcde" * 120)]  # mesh pad rows + chunking
+
+    ref = BatchRunner(
+        weights=weights, lut=None, spec=spec, cuckoo=cuckoo,
+        strategy="gather", length_buckets=(128, 256),
+    ).score(docs)
+    got = BatchRunner(
+        weights=weights, lut=None, spec=spec, cuckoo=cuckoo,
+        strategy="hist", mesh=resolve_mesh("mesh"),
+        length_buckets=(128, 256),
+    ).score(docs)
+    np.testing.assert_allclose(got, ref, atol=1e-3)

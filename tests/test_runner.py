@@ -1,11 +1,13 @@
 """BatchRunner: bucketing, long-document chunking exactness, order recovery."""
 
+import jax.numpy as jnp
 import numpy as np
 
 from spark_languagedetector_tpu.api.runner import BatchRunner
 from spark_languagedetector_tpu.models.profile import GramProfile
 from spark_languagedetector_tpu.ops.encoding import texts_to_bytes
 from spark_languagedetector_tpu.ops.score import score_batch_numpy
+from spark_languagedetector_tpu.ops.vocab import EXACT, VocabSpec
 
 from .oracle import scores_oracle
 
@@ -74,3 +76,50 @@ def test_throughput_metrics_populated():
     assert runner.metrics.counters["docs_scored"] == 2
     assert runner.metrics.timers["score_s"] > 0
     assert runner.metrics.throughput("docs_scored", "score_s") > 0
+
+
+def test_predict_ids_matches_host_argmax_with_chunked_docs():
+    """The device-argmax label path must agree with np.argmax over score()
+    for every doc — including chunked long docs (cross-chunk sums happen
+    before argmax), empty docs (index 0), and tie rows (first max wins)."""
+    rng = np.random.default_rng(31)
+    spec = VocabSpec(EXACT, (1, 2))
+    V, L = spec.id_space_size, 4
+    weights = rng.normal(size=(V, L)).astype(np.float32)
+    runner = BatchRunner(
+        weights=jnp.asarray(weights), lut=None, spec=spec,
+        strategy="gather", length_buckets=(64, 128), batch_size=8,
+    )
+    docs = [
+        bytes(rng.integers(97, 122, rng.integers(0, 100)).tolist())
+        for _ in range(23)
+    ] + [b"", b"a", bytes(b"tie" * 200)]  # chunked doc at 600 > 128
+    scores = runner.score(docs)
+    ids = runner.predict_ids(docs)
+    np.testing.assert_array_equal(ids, np.argmax(scores, axis=1))
+    assert ids[len(docs) - 3] == 0  # empty doc -> first language (Q6)
+
+
+def test_predict_ids_mesh(eight_devices):
+    """Label path under a data-parallel mesh (pad rows dropped)."""
+    rng = np.random.default_rng(33)
+    spec = VocabSpec(EXACT, (1, 2))
+    weights = rng.normal(size=(spec.id_space_size, 3)).astype(np.float32)
+    from spark_languagedetector_tpu.api.runner import resolve_mesh
+
+    docs = [
+        bytes(rng.integers(97, 122, rng.integers(0, 60)).tolist())
+        for _ in range(11)
+    ]
+    single = BatchRunner(
+        weights=jnp.asarray(weights), lut=None, spec=spec,
+        strategy="gather", length_buckets=(64,), batch_size=8,
+    )
+    meshed = BatchRunner(
+        weights=jnp.asarray(weights), lut=None, spec=spec,
+        strategy="gather", length_buckets=(64,), batch_size=8,
+        mesh=resolve_mesh("mesh"),
+    )
+    np.testing.assert_array_equal(
+        meshed.predict_ids(docs), np.argmax(single.score(docs), axis=1)
+    )

@@ -1,0 +1,97 @@
+"""Randomized cross-strategy parity sweep.
+
+Fixed-seed fuzz over vocab shapes x strategies: every device scoring
+strategy must agree with the float64 numpy host scorer (the oracle bridge)
+on scores (tolerance) and argmax (exactly) for random byte corpora that
+include empty docs, sub-gram docs, NUL/0xFF bytes, and chunk-length docs.
+This is the generalization of the per-strategy parity tests: one sweep per
+(spec, strategy) pair the auto-selector can produce.
+"""
+
+import numpy as np
+import pytest
+
+from spark_languagedetector_tpu.api.runner import BatchRunner
+from spark_languagedetector_tpu.models.profile import GramProfile
+from spark_languagedetector_tpu.ops.score import score_batch_numpy
+from spark_languagedetector_tpu.ops.vocab import EXACT, HASHED, VocabSpec
+
+CASES = [
+    # (spec, strategies that must handle it)
+    (VocabSpec(EXACT, (2,)), ("gather", "onehot", "pallas")),
+    (VocabSpec(EXACT, (1, 2)), ("gather", "onehot", "pallas")),
+    (VocabSpec(EXACT, (1, 2, 3)), ("gather", "hybrid", "hist")),
+    (VocabSpec(EXACT, (1, 3, 5)), ("gather", "hist")),
+    (VocabSpec(EXACT, (4,)), ("gather", "hist")),
+    (VocabSpec(EXACT, (1, 2, 3, 4, 5)), ("gather", "hybrid", "hist")),
+    # Small hashed vocabs ship the DENSE table (no LUT/cuckoo), so hist
+    # does not apply; fnv1a bucket ids are not exact short-gram ids, so
+    # hybrid doesn't either — gather is the one strategy for this shape.
+    (VocabSpec(HASHED, (1, 2, 3), hash_bits=11), ("gather",)),
+    (VocabSpec(HASHED, (1, 2, 3, 4, 5), hash_bits=17, hash_scheme="exact12"),
+     ("gather", "hybrid")),
+]
+
+
+def _profile(spec, rng, n_langs=4, n_grams=250):
+    """Random trained-profile-shaped GramProfile for the spec."""
+    grams = set()
+    lo, hi = min(spec.gram_lengths), max(spec.gram_lengths)
+    while len(grams) < n_grams:
+        n = int(rng.integers(lo, hi + 1))
+        grams.add(bytes(rng.integers(95, 115, n).tolist()))
+    gram_map = {
+        g: rng.normal(size=n_langs).astype(np.float64) for g in sorted(grams)
+    }
+    if spec.mode == EXACT:
+        return GramProfile.from_gram_map(
+            gram_map, tuple(f"l{i}" for i in range(n_langs)),
+            spec.gram_lengths,
+        )
+    # hashed: accumulate gram weights into buckets like the fit does
+    ids = {}
+    for g, v in gram_map.items():
+        ids.setdefault(spec.gram_to_id(g), np.zeros(n_langs)).__iadd__(v)
+    sorted_ids = np.asarray(sorted(ids), dtype=np.int64)
+    weights = np.stack([ids[i] for i in sorted_ids])
+    return GramProfile(
+        spec=spec, languages=tuple(f"l{i}" for i in range(n_langs)),
+        ids=sorted_ids, weights=weights,
+    )
+
+
+def _docs(rng):
+    docs = [
+        bytes(rng.integers(90, 120, int(rng.integers(0, 150))).tolist())
+        for _ in range(17)
+    ]
+    docs += [b"", b"a", b"ab", b"abc", b"\x00\xff" * 20,
+             bytes(rng.integers(0, 256, 700).tolist())]  # chunked at 256
+    return docs
+
+
+@pytest.mark.parametrize(
+    "case_idx", range(len(CASES)), ids=[str(c[0]) for c in CASES]
+)
+def test_all_strategies_match_host_scorer(case_idx):
+    spec, strategies = CASES[case_idx]
+    rng = np.random.default_rng(1000 + case_idx)
+    profile = _profile(spec, rng)
+    docs = _docs(rng)
+    host_w, host_ids = profile.host_arrays()
+    want = score_batch_numpy(docs, host_w, host_ids, spec)
+    weights, lut, cuckoo = profile.device_membership()
+    for strategy in strategies:
+        runner = BatchRunner(
+            weights=weights, lut=lut, cuckoo=cuckoo, spec=spec,
+            strategy=strategy, length_buckets=(128, 256), batch_size=8,
+        )
+        got = runner.score(docs)
+        np.testing.assert_allclose(
+            got, want, rtol=1e-4, atol=1e-3,
+            err_msg=f"{spec} strategy={strategy}",
+        )
+        np.testing.assert_array_equal(
+            runner.predict_ids(docs), np.argmax(got, axis=1),
+            err_msg=f"{spec} strategy={strategy} labels",
+        )

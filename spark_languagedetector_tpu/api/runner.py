@@ -33,9 +33,11 @@ from ..ops import score_hist
 from ..ops import score_pallas
 from ..ops.encoding import (
     DEFAULT_LENGTH_BUCKETS,
+    RAGGED_CHUNK,
     bucket_length,
     chunk_document,
     pad_batch,
+    unpack_ragged,
 )
 from ..ops.vocab import VocabSpec
 from ..utils.logging import get_logger, log_event
@@ -48,6 +50,16 @@ _log = get_logger("api.runner")
 # host I/O. Programming errors (TypeError, ValueError, shape bugs) propagate
 # immediately with their original traceback instead of being re-executed.
 RETRYABLE = (RuntimeError, OSError)
+
+# Device-side inverse of the ragged packer (ops.encoding.unpack_ragged),
+# jitted once per (flat-chunks, rows, pad_to) shape triple — all three are
+# bucketed, so the compile count stays bounded.
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("pad_to",))
+def _unpack_ragged_jit(flat, offs, lengths, pad_to: int):
+    return unpack_ragged(flat, offs, lengths, pad_to)
 
 DEFAULT_BATCH_SIZE = 256
 # The fused pallas kernel keeps per-document state in VMEM scratch (no
@@ -171,6 +183,12 @@ class BatchRunner:
     # Mutually exclusive with `device`.
     mesh: object | None = None
     strategy: str = "auto"  # 'auto'|'gather'|'onehot'|'pallas'|'hybrid'|'hist'
+    # Ragged h2d transfer (chunk-aligned flat buffer + device-side unpack
+    # gather; see ops.encoding.pack_ragged_numpy). None ⇒ on for
+    # single-device dispatch, off on a mesh (the data-axis sharding of the
+    # padded batch is what GSPMD partitions; a replicated flat buffer would
+    # forfeit the sharded transfer).
+    ragged_transfer: bool | None = None
     # Cuckoo membership (ops.cuckoo.CuckooTable, host arrays) for exact
     # vocabs with gram lengths > 3 — routed through the gather-style
     # dispatch with packed-key lookups instead of a LUT.
@@ -181,6 +199,8 @@ class BatchRunner:
         # Created first: strategy auto-selection below may already resolve
         # lazy state through the lock.
         self._state_lock = threading.Lock()
+        if self.ragged_transfer is None:
+            self.ragged_transfer = self.mesh is None
         if self.mesh is not None:
             if self.device is not None:
                 raise ValueError("pass either device or mesh, not both")
@@ -703,6 +723,25 @@ class BatchRunner:
         window_limit = (
             None if limit_np is None else jax.device_put(limit_np, placement)
         )
+        return self._dispatch_device(batch, lengths, window_limit, placement)
+
+    def _dispatch_ragged(self, flat_np, offs_np, lengths_np, limit_np,
+                         placement, pad_to: int):
+        """Ragged-transfer dispatch: ship the chunk-aligned flat buffer
+        (raw bytes + ~64B/doc alignment, vs bucket-width rows — ~15-20%
+        fewer wire bytes at typical fill factors) and rebuild the exact
+        padded batch on device with one lane-width row gather. Downstream
+        scoring sees a batch bit-identical to the padded path's."""
+        flat = jax.device_put(flat_np, placement)
+        offs = jax.device_put(offs_np, placement)
+        lengths = jax.device_put(lengths_np, placement)
+        window_limit = (
+            None if limit_np is None else jax.device_put(limit_np, placement)
+        )
+        batch = _unpack_ragged_jit(flat, offs, lengths, pad_to)
+        return self._dispatch_device(batch, lengths, window_limit, placement)
+
+    def _dispatch_device(self, batch, lengths, window_limit, placement):
         if self.strategy == "pallas":
             interpret, w1, w2 = self._pallas_state()
             return self._pallas_dispatch(
@@ -843,7 +882,6 @@ class BatchRunner:
                     self._ndata,
                     (batch_limits, self.max_chunk),
                 )
-            batch_np, lengths_np = self._pack(batch_docs, pad_to)
             # Batches without chunked docs (the common case) skip the
             # window-limit array entirely — one fewer host→device
             # transfer and a simpler compiled program.
@@ -851,6 +889,27 @@ class BatchRunner:
                 limit_np = None
             else:
                 limit_np = np.asarray(batch_limits, dtype=np.int32)
+            if (
+                self.ragged_transfer
+                and self.mesh is None
+                and pad_to % RAGGED_CHUNK == 0
+                # Tiny tail batches: the flat buffer's 256-chunk floor
+                # would EXCEED the padded transfer — ship padded instead.
+                and len(batch_docs) * pad_to > 256 * RAGGED_CHUNK
+            ):
+                from .. import native
+
+                # Flat sizes rounded to 1/16 of this geometry's padded
+                # chunk count: stable-fill batches land on 1-3 compiled
+                # C shapes per (B, S) at ~3% mean bucket waste.
+                step = (len(batch_docs) * pad_to // RAGGED_CHUNK) // 16
+                flat_np, offs_np, lengths_np = native.pack_ragged(
+                    batch_docs, pad_to, flat_step=step
+                )
+                return self._dispatch_ragged(
+                    flat_np, offs_np, lengths_np, limit_np, placement, pad_to
+                )
+            batch_np, lengths_np = self._pack(batch_docs, pad_to)
             return self._dispatch_batch(batch_np, lengths_np, limit_np, placement)
 
         doc_idx_arr = np.asarray(doc_idx, dtype=np.int64)

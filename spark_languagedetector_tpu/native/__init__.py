@@ -82,6 +82,12 @@ def _load() -> ctypes.CDLL | None:
             ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
         ]
         lib.pack_batch.restype = None
+        lib.pack_ragged.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int32,
+        ]
+        lib.pack_ragged.restype = None
         lib.clean_bytes.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
         ]
@@ -129,6 +135,47 @@ def pack_batch(
         n_threads,
     )
     return out, out_lens
+
+
+def pack_ragged(
+    byte_docs, pad_to: int, flat_step: int | None = None,
+    n_threads: int | None = None,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Native ragged packing: list[bytes] → (flat uint8 [C, 128], offs
+    int32 [B], lengths int32 [B]) — the wire-efficient transfer form (see
+    ``ops.encoding.pack_ragged_numpy``, its host mirror and fallback).
+
+    Offset/size bookkeeping is vectorized numpy either way; the native
+    library only replaces the per-document copy loop.
+    """
+    from ..ops.encoding import RAGGED_CHUNK, pack_ragged_numpy, ragged_layout
+
+    lib = _load()
+    if lib is None:
+        return pack_ragged_numpy(byte_docs, pad_to, flat_step)
+
+    n = len(byte_docs)
+    flat, offs, lengths = ragged_layout(byte_docs, pad_to, flat_step)
+    if n:
+        ptrs = (ctypes.c_char_p * n)(*byte_docs)
+        lens64 = np.fromiter(
+            (len(d) for d in byte_docs), dtype=np.int64, count=n
+        )
+        out_lens = np.empty(n, dtype=np.int32)  # C re-derives the clamp
+        if n_threads is None:
+            n_threads = min(8, os.cpu_count() or 1)
+        lib.pack_ragged(
+            ptrs,
+            lens64.ctypes.data_as(ctypes.c_void_p),
+            n,
+            pad_to,
+            RAGGED_CHUNK,
+            offs.ctypes.data_as(ctypes.c_void_p),
+            flat.ctypes.data_as(ctypes.c_void_p),
+            out_lens.ctypes.data_as(ctypes.c_void_p),
+            n_threads,
+        )
+    return flat, offs, lengths
 
 
 def clean_bytes(data: bytes) -> bytes:

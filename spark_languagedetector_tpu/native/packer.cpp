@@ -18,6 +18,29 @@
 #include <thread>
 #include <vector>
 
+namespace {
+
+// Run fn(i) for i in [0, n) across up to n_threads threads (contiguous
+// range partition; joins before returning).
+template <typename Fn>
+void parallel_for(int64_t n, int32_t n_threads, Fn fn) {
+  int threads = std::max(1, n_threads);
+  threads = static_cast<int>(std::min<int64_t>(threads, n));
+  std::vector<std::thread> pool;
+  int64_t per = (n + threads - 1) / threads;
+  for (int t = 0; t < threads; ++t) {
+    int64_t lo = t * per;
+    int64_t hi = std::min(n, lo + per);
+    if (lo >= hi) break;
+    pool.emplace_back([=]() {
+      for (int64_t i = lo; i < hi; ++i) fn(i);
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+}  // namespace
+
 extern "C" {
 
 // Pack n_docs ragged byte strings into out[n_docs * pad_to] (zero-padded)
@@ -34,23 +57,35 @@ void pack_batch(const uint8_t* const* docs,
                 int32_t n_threads) {
   if (n_docs <= 0) return;
   std::memset(out, 0, static_cast<size_t>(n_docs) * pad_to);
-  int threads = std::max(1, n_threads);
-  threads = static_cast<int>(std::min<int64_t>(threads, n_docs));
-  std::vector<std::thread> pool;
-  int64_t per = (n_docs + threads - 1) / threads;
-  for (int t = 0; t < threads; ++t) {
-    int64_t lo = t * per;
-    int64_t hi = std::min(n_docs, lo + per);
-    if (lo >= hi) break;
-    pool.emplace_back([=]() {
-      for (int64_t i = lo; i < hi; ++i) {
-        int64_t n = std::min<int64_t>(lens[i], pad_to);
-        if (n > 0) std::memcpy(out + i * pad_to, docs[i], n);
-        out_lens[i] = static_cast<int32_t>(n);
-      }
-    });
-  }
-  for (auto& th : pool) th.join();
+  parallel_for(n_docs, n_threads, [=](int64_t i) {
+    int64_t n = std::min<int64_t>(lens[i], pad_to);
+    if (n > 0) std::memcpy(out + i * pad_to, docs[i], n);
+    out_lens[i] = static_cast<int32_t>(n);
+  });
+}
+
+// Ragged packing for the wire-efficient transfer path: copy each document
+// into a flat chunk-aligned buffer at a caller-computed chunk offset
+// (offs[i] is document i's first chunk index; chunk row 0 is reserved as
+// the all-zeros miss row the device-side unpack gather reads for
+// out-of-range chunks). The caller zeroes `flat` and sizes it to the
+// bucketed chunk count; this routine is the memcpy loop only.
+void pack_ragged(const uint8_t* const* docs,
+                 const int64_t* lens,
+                 int64_t n_docs,
+                 int64_t pad_to,
+                 int64_t chunk,
+                 const int32_t* offs,
+                 uint8_t* flat,
+                 int32_t* out_lens,
+                 int32_t n_threads) {
+  if (n_docs <= 0) return;
+  parallel_for(n_docs, n_threads, [=](int64_t i) {
+    int64_t n = std::min<int64_t>(lens[i], pad_to);
+    if (n > 0) std::memcpy(flat + static_cast<int64_t>(offs[i]) * chunk,
+                           docs[i], n);
+    out_lens[i] = static_cast<int32_t>(n);
+  });
 }
 
 // Byte-level special-character strip + ASCII-whitespace squash. Multi-byte

@@ -1,0 +1,99 @@
+"""Ragged (wire-efficient) transfer path: pack/unpack parity and runner
+equivalence.
+
+The ragged form exists purely to shrink the h2d transfer; its contract is
+that the device-side unpack reconstructs the padded batch *bit-exactly*
+(``ops.encoding.pack_ragged_numpy`` docstring), so every scoring strategy
+is untouched downstream. These tests pin that contract.
+"""
+
+import numpy as np
+import pytest
+
+from spark_languagedetector_tpu import native
+from spark_languagedetector_tpu.api.runner import BatchRunner
+from spark_languagedetector_tpu.ops.encoding import (
+    RAGGED_CHUNK,
+    pack_ragged_numpy,
+    pad_batch,
+    round_chunks,
+    unpack_ragged,
+)
+from spark_languagedetector_tpu.ops.vocab import VocabSpec
+
+
+def _fuzz_docs(rng, n):
+    docs = []
+    for _ in range(n):
+        kind = rng.integers(0, 5)
+        if kind == 0:
+            docs.append(b"")
+        elif kind == 1:  # exact chunk multiples (boundary)
+            docs.append(bytes(rng.integers(0, 256, RAGGED_CHUNK * int(rng.integers(1, 4)), dtype=np.uint8)))
+        elif kind == 2:  # longer than pad_to (truncation)
+            docs.append(bytes(rng.integers(0, 256, 3000, dtype=np.uint8)))
+        else:
+            docs.append(bytes(rng.integers(0, 256, int(rng.integers(1, 1000)), dtype=np.uint8)))
+    return docs
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_unpack_reconstructs_padded_batch_bit_exactly(seed):
+    rng = np.random.default_rng(seed)
+    docs = _fuzz_docs(rng, 37)
+    pad_to = 1024
+    want, want_lens = pad_batch(docs, pad_to=pad_to)
+    flat, offs, lens = pack_ragged_numpy(docs, pad_to)
+    np.testing.assert_array_equal(lens, want_lens)
+    got = np.asarray(unpack_ragged(flat, offs, lens, pad_to))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_native_pack_ragged_matches_numpy():
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(3)
+    docs = _fuzz_docs(rng, 53)
+    pad_to = 512
+    f_np, o_np, l_np = pack_ragged_numpy(docs, pad_to)
+    f_c, o_c, l_c = native.pack_ragged(docs, pad_to)
+    np.testing.assert_array_equal(o_c, o_np)
+    np.testing.assert_array_equal(l_c, l_np)
+    np.testing.assert_array_equal(f_c, f_np)
+
+
+def test_round_chunks_buckets():
+    assert round_chunks(1) == 256
+    assert round_chunks(256) == 256
+    for c in [257, 1000, 5000, 65536]:
+        assert round_chunks(c) >= c
+    # with a step, sizes are multiples of it and waste is bounded by it
+    assert round_chunks(1000, step=4096) == 4096
+    assert round_chunks(5000, step=4096) == 8192
+    assert round_chunks(100, step=10) == 256  # floor at the base bucket
+
+
+def _small_runner(ragged):
+    rng = np.random.default_rng(7)
+    spec = VocabSpec(mode="hashed", gram_lengths=(1, 2, 3), hash_bits=12)
+    weights = rng.normal(size=(spec.id_space_size, 5)).astype(np.float32)
+    return BatchRunner(
+        weights=weights, lut=None, spec=spec, ragged_transfer=ragged
+    )
+
+
+def test_runner_scores_identical_with_and_without_ragged():
+    rng = np.random.default_rng(11)
+    docs = _fuzz_docs(rng, 64)
+    want = _small_runner(False).score(docs)
+    got = _small_runner(True).score(docs)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_runner_labels_identical_with_and_without_ragged():
+    rng = np.random.default_rng(13)
+    docs = _fuzz_docs(rng, 40)
+    langs = ["a", "b", "c", "d", "e"]
+    want = _small_runner(False).predict_ids(docs)
+    got = _small_runner(True).predict_ids(docs)
+    np.testing.assert_array_equal(got, want)

@@ -38,7 +38,7 @@ def test_dispatch_retry_recovers_transient_failure(monkeypatch):
     want = runner.score(docs)
 
     calls = {"n": 0}
-    orig = BatchRunner._dispatch_batch
+    orig = BatchRunner._dispatch_device
 
     def flaky(self, *a, **kw):
         calls["n"] += 1
@@ -46,7 +46,7 @@ def test_dispatch_retry_recovers_transient_failure(monkeypatch):
             raise RuntimeError("transient tunnel hiccup")
         return orig(self, *a, **kw)
 
-    monkeypatch.setattr(BatchRunner, "_dispatch_batch", flaky)
+    monkeypatch.setattr(BatchRunner, "_dispatch_device", flaky)
     got = runner.score(docs)
     np.testing.assert_allclose(got, want, rtol=1e-6)
     assert runner.metrics.snapshot()["counters"].get("retries") == 1
@@ -64,7 +64,7 @@ def test_fetch_retry_replays_batch(monkeypatch):
         def __array__(self, *a, **kw):
             raise RuntimeError("execution failed on device")
 
-    orig = BatchRunner._dispatch_batch
+    orig = BatchRunner._dispatch_device
     state = {"calls": 0, "poisoned": False}
 
     def flaky(self, *a, **kw):
@@ -74,7 +74,7 @@ def test_fetch_retry_replays_batch(monkeypatch):
             return Poisoned()
         return orig(self, *a, **kw)
 
-    monkeypatch.setattr(BatchRunner, "_dispatch_batch", flaky)
+    monkeypatch.setattr(BatchRunner, "_dispatch_device", flaky)
     got = runner.score(docs)
     np.testing.assert_allclose(got, want, rtol=1e-6)
     assert runner.metrics.snapshot()["counters"].get("retries") == 1

@@ -185,9 +185,9 @@ class BatchRunner:
     strategy: str = "auto"  # 'auto'|'gather'|'onehot'|'pallas'|'hybrid'|'hist'
     # Ragged h2d transfer (chunk-aligned flat buffer + device-side unpack
     # gather; see ops.encoding.pack_ragged_numpy). None ⇒ on for
-    # single-device dispatch, off on a mesh (the data-axis sharding of the
-    # padded batch is what GSPMD partitions; a replicated flat buffer would
-    # forfeit the sharded transfer).
+    # single-device dispatch. Ignored on a mesh — even if set True — since
+    # the data-axis sharding of the padded batch is what GSPMD partitions;
+    # a replicated flat buffer would forfeit the sharded transfer.
     ragged_transfer: bool | None = None
     # Cuckoo membership (ops.cuckoo.CuckooTable, host arrays) for exact
     # vocabs with gram lengths > 3 — routed through the gather-style
@@ -893,22 +893,33 @@ class BatchRunner:
                 self.ragged_transfer
                 and self.mesh is None
                 and pad_to % RAGGED_CHUNK == 0
-                # Tiny tail batches: the flat buffer's 256-chunk floor
-                # would EXCEED the padded transfer — ship padded instead.
-                and len(batch_docs) * pad_to > 256 * RAGGED_CHUNK
             ):
                 from .. import native
+                from ..ops.encoding import round_chunks
 
                 # Flat sizes rounded to 1/16 of this geometry's padded
                 # chunk count: stable-fill batches land on 1-3 compiled
                 # C shapes per (B, S) at ~3% mean bucket waste.
                 step = (len(batch_docs) * pad_to // RAGGED_CHUNK) // 16
-                flat_np, offs_np, lengths_np = native.pack_ragged(
-                    batch_docs, pad_to, flat_step=step
+                # Size-only precheck: ragged only wins when the bucketed
+                # flat buffer is actually smaller than the padded batch —
+                # narrow buckets (pad_to <= 2 chunks), high-fill batches,
+                # and tiny tails below the 256-chunk floor all lose.
+                total = 1 + sum(
+                    -(-min(len(d), pad_to) // RAGGED_CHUNK)
+                    for d in batch_docs
                 )
-                return self._dispatch_ragged(
-                    flat_np, offs_np, lengths_np, limit_np, placement, pad_to
-                )
+                if (
+                    round_chunks(total, step) * RAGGED_CHUNK
+                    < len(batch_docs) * pad_to
+                ):
+                    flat_np, offs_np, lengths_np = native.pack_ragged(
+                        batch_docs, pad_to, flat_step=step
+                    )
+                    return self._dispatch_ragged(
+                        flat_np, offs_np, lengths_np, limit_np, placement,
+                        pad_to,
+                    )
             batch_np, lengths_np = self._pack(batch_docs, pad_to)
             return self._dispatch_batch(batch_np, lengths_np, limit_np, placement)
 

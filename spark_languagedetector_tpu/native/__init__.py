@@ -77,6 +77,23 @@ def _load() -> ctypes.CDLL | None:
         except OSError as e:
             log_event(_log, "native.load_failed", error=str(e))
             return None
+        # A cached .so whose mtime defeats the staleness check (build-cache
+        # restore, rsync -t) can predate newer entry points: rebuild once if
+        # any expected symbol is missing, else fall back to numpy — symbol
+        # skew must never break the transparent-fallback contract.
+        expected = ("pack_batch", "pack_ragged", "clean_bytes", "ascii_lower")
+        if not all(hasattr(lib, s) for s in expected):
+            log_event(_log, "native.symbols_missing", path=str(_SO))
+            del lib  # release the handle before replacing the file
+            if not _build():
+                return None
+            try:
+                lib = ctypes.CDLL(str(_SO))
+            except OSError as e:
+                log_event(_log, "native.load_failed", error=str(e))
+                return None
+            if not all(hasattr(lib, s) for s in expected):
+                return None
         lib.pack_batch.argtypes = [
             ctypes.POINTER(ctypes.c_char_p), ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
@@ -161,7 +178,6 @@ def pack_ragged(
         lens64 = np.fromiter(
             (len(d) for d in byte_docs), dtype=np.int64, count=n
         )
-        out_lens = np.empty(n, dtype=np.int32)  # C re-derives the clamp
         if n_threads is None:
             n_threads = min(8, os.cpu_count() or 1)
         lib.pack_ragged(
@@ -172,7 +188,9 @@ def pack_ragged(
             RAGGED_CHUNK,
             offs.ctypes.data_as(ctypes.c_void_p),
             flat.ctypes.data_as(ctypes.c_void_p),
-            out_lens.ctypes.data_as(ctypes.c_void_p),
+            # C writes the same clamp ragged_layout already computed —
+            # hand it lengths' own buffer rather than a throwaway array.
+            lengths.ctypes.data_as(ctypes.c_void_p),
             n_threads,
         )
     return flat, offs, lengths

@@ -90,6 +90,29 @@ def test_runner_scores_identical_with_and_without_ragged():
     np.testing.assert_array_equal(got, want)
 
 
+def test_narrow_buckets_keep_padded_path(monkeypatch):
+    """Docs in the 128/256 buckets need pad_to/128 chunks each — ragged can
+    never ship fewer bytes there, so the size precheck must route them
+    through the padded transfer."""
+    calls = {"ragged": 0}
+    orig = BatchRunner._dispatch_ragged
+
+    def counting(self, *a, **kw):
+        calls["ragged"] += 1
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(BatchRunner, "_dispatch_ragged", counting)
+    rng = np.random.default_rng(17)
+    short = [bytes(rng.integers(0, 256, 100, dtype=np.uint8)) for _ in range(64)]
+    _small_runner(True).score(short)  # all land in the 128 bucket
+    assert calls["ragged"] == 0
+    # sanity: low-fill wide-bucket docs DO take the ragged path
+    # (1100B in the 1536 bucket: 9 chunks = 1152B shipped vs 1536 padded)
+    wide = [bytes(rng.integers(0, 256, 1100, dtype=np.uint8)) for _ in range(256)]
+    _small_runner(True).score(wide)
+    assert calls["ragged"] > 0
+
+
 def test_runner_labels_identical_with_and_without_ragged():
     rng = np.random.default_rng(13)
     docs = _fuzz_docs(rng, 40)

@@ -258,6 +258,38 @@ def time_baselines(model, sub, scorer):
     return len(t_sub) / t_base, len(sub) / t_np
 
 
+def measure_wire_mbps():
+    """h2d bandwidth probe: best-of-3 timed 4MB device_puts, RTT-corrected.
+
+    Self-documents the relay's bandwidth weather in the artifact so a low
+    end-to-end number can be read against the link, not the kernels (the
+    tunneled wire swings 3-90MB/s across sessions with identical code).
+    Each put is bounded by a scalar fetch; the fetch's round-trip is
+    measured separately (a 1-byte put + the same fetch) and subtracted so
+    a fast-but-high-RTT link is not misreported as slow.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        rng = np.random.default_rng(0)
+
+        def timed_put(nbytes):
+            buf = rng.integers(0, 256, (nbytes,), np.uint8)
+            t0 = time.perf_counter()
+            dev = jax.device_put(buf)
+            # A scalar reduce + fetch bounds the put's completion.
+            float(jnp.sum(dev[:: 1 << 18].astype(jnp.int32)))
+            return time.perf_counter() - t0
+
+        timed_put(4 << 20)  # warm allocator + compile, discarded
+        rtt = min(timed_put(1) for _ in range(3))
+        best = min(timed_put(4 << 20) for _ in range(3))
+        return round((4 << 20) / max(best - rtt, 1e-4) / 1e6, 1)
+    except Exception:
+        return None
+
+
 def measure_compute_only(model, eval_docs):
     """Device docs/s with operands already resident — no host->device wire.
 
@@ -327,14 +359,17 @@ def run_config(num: int) -> dict:
             base_pred, sub, scorer = baseline_fut.result()
             baseline_dps, baseline_np_dps = time_baselines(model, sub, scorer)
             times = []
-            # Streaming is transfer-bound like the other short-gram configs:
-            # same extra-pass rule. Four transform workers with a deep prefetch
+            # Streaming is transfer-bound like the other short-gram configs
+            # and gets extra passes the same way (7 here: streaming passes
+            # run the whole corpus through the engine, so they are slower
+            # than the batch path's and one fewer keeps the budget).
+            # Four transform workers with a deep prefetch
             # keep the bursty wire saturated across batches (A/B on the
             # tunneled v5e: w2/p3 11.3k, w4/p6 24.9-25.2k rows/s in the same
             # window; w6+/deeper plateaus). 8192-row source batches beat 4096
             # consistently (fewer transform calls, deeper in-call pipelining;
             # 19.9k vs 13.7k rows/s on a cold wire, ~5% ahead when warm).
-            for _ in range(5 if max(cfg["gram_lengths"]) <= 3 else 3):
+            for _ in range(7 if max(cfg["gram_lengths"]) <= 3 else 3):
                 t0 = time.perf_counter()
                 q = run_stream(
                     model, memory_source(rows, 8192), sink_rows.append,
@@ -378,10 +413,11 @@ def run_config(num: int) -> dict:
             # bursty latency/bandwidth that can dominate a single pass; the best
             # pass is the closest observable to steady-state throughput. The
             # median is reported alongside so the burst variance is visible.
-            # Transfer-bound configs (short gram lengths ⇒ compute hides under
-            # the wire) get extra passes because the wire's variance is larger
-            # than the compute-bound configs'.
-            n_passes = 5 if max(cfg["gram_lengths"]) <= 3 else 3
+            # Transfer-bound configs (short gram lengths ⇒ compute hides
+            # under the wire) get extra passes: each is ~0.5-1.5s and the
+            # relay's stall windows last seconds, so more samples raise the
+            # odds that min-time lands in clear weather.
+            n_passes = 8 if max(cfg["gram_lengths"]) <= 3 else 4
             pass_times = []
             for _ in range(n_passes):
                 t0 = time.perf_counter()
@@ -408,6 +444,7 @@ def run_config(num: int) -> dict:
         import jax
 
         compute_dps = measure_compute_only(model, eval_docs)
+        wire_mbps = measure_wire_mbps()
         result = {
             "metric": f"langid docs/sec/chip ({cfg['label']}, {jax.default_backend()})",
             "value": round(device_dps, 1),
@@ -425,6 +462,8 @@ def run_config(num: int) -> dict:
             "eval_docs": n_docs,
             "eval_mb": round(eval_bytes / 1e6, 1),
         }
+        if wire_mbps is not None:
+            result["wire_mbps"] = wire_mbps
         if compute_dps:
             # Conservative kernel rate: full-width docs (truncated to the widest
             # bucket), resident operands. End-to-end `value` can exceed it when
@@ -432,6 +471,20 @@ def run_config(num: int) -> dict:
             result["compute_docs_per_s"] = round(compute_dps, 1)
         if not cfg.get("streaming"):
             result["strategy"] = model._get_runner().strategy
+        if num == 2:
+            # Harder eval leg: 200-char docs (tweet-length) — the 1.5KB
+            # corpus saturates at accuracy 1.0; short docs show the
+            # realistic operating point of the same model.
+            sd_docs, sd_labels = make_corpus(langs, 2000, mean_len=200, seed=9)
+            from spark_languagedetector_tpu import Table as _T
+
+            sd_out = model.transform(_T({"fulltext": sd_docs}))
+            result["shortdoc_accuracy"] = round(float(np.mean([
+                a == b
+                for a, b in zip(
+                    sd_out.column(model.get_output_col()), sd_labels
+                )
+            ])), 4)
         if baseline_dps:
             result["vs_baseline"] = round(device_dps / baseline_dps, 2)
             result["vs_numpy"] = round(device_dps / baseline_np_dps, 2)

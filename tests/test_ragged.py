@@ -38,10 +38,10 @@ def _fuzz_docs(rng, n):
 
 
 @pytest.mark.parametrize("seed", [0, 1, 2])
-def test_unpack_reconstructs_padded_batch_bit_exactly(seed):
+@pytest.mark.parametrize("pad_to", [128, 1024, 8192])
+def test_unpack_reconstructs_padded_batch_bit_exactly(seed, pad_to):
     rng = np.random.default_rng(seed)
     docs = _fuzz_docs(rng, 37)
-    pad_to = 1024
     want, want_lens = pad_batch(docs, pad_to=pad_to)
     flat, offs, lens = pack_ragged_numpy(docs, pad_to)
     np.testing.assert_array_equal(lens, want_lens)

@@ -293,34 +293,52 @@ def measure_wire_mbps():
 def measure_compute_only(model, eval_docs):
     """Device docs/s with operands already resident — no host->device wire.
 
-    Packs one full-size micro-batch of real eval docs (truncated to the
-    widest length bucket; rate measurement, not scoring output), puts it on
-    device once, then times 10 queued dispatches per repetition with a
-    single reduced-scalar fetch (the axon-relay methodology: per-call d2h
-    syncs would measure tunnel latency, not compute).
+    Measures at exactly the production shape: ``batch_size`` rows (corpus
+    tiled if shorter) at the eval docs' own length bucket, so the rate is
+    directly comparable to ``value``. The relay can serve repeated
+    identical (executable, args) executions from a cache
+    (docs/PERFORMANCE.md §5), so every timed dispatch uses a buffer the
+    relay has never executed: 13 row-rotations of the packed batch
+    (identical compute cost, distinct contents), one spent on warmup and
+    never timed, the rest dispatched exactly once each across 3 reps.
     """
     import jax
 
+    from spark_languagedetector_tpu.ops.encoding import bucket_length
+
     runner = model._get_runner()
-    pad_to = runner.max_chunk
-    docs_b = [t.encode("utf-8")[:pad_to] for t in eval_docs[: runner.batch_size]]
-    batch_np, lengths_np = runner._pack(docs_b, pad_to)
     if runner.mesh is not None:
         return None  # single-device measurement only
-    batch = jax.device_put(batch_np, runner.device)
-    lengths = jax.device_put(lengths_np, runner.device)
-    out = runner._dispatch_batch(batch, lengths, None, runner.device)
-    np.asarray(out)  # warm: compile + first run outside the timed window
-    best = np.inf
-    for _ in range(3):
+    rows = runner.batch_size
+    docs_b = [t.encode("utf-8") for t in eval_docs]
+    while len(docs_b) < rows:  # tile short corpora up to production size
+        docs_b = docs_b + docs_b
+    docs_b = docs_b[:rows]
+    pad_to = bucket_length(max(len(d) for d in docs_b), runner.length_buckets)
+    docs_b = [d[:pad_to] for d in docs_b]
+    batch_np, lengths_np = runner._pack(docs_b, pad_to)
+    groups = [
+        (
+            jax.device_put(np.roll(batch_np, g, axis=0), runner.device),
+            jax.device_put(np.roll(lengths_np, g), runner.device),
+        )
+        for g in range(13)
+    ]
+    # Warm compile + first execution on the one rotation the loop never
+    # times (its (args, executable) pair must not recur).
+    wb, wl = groups[12]
+    np.asarray(runner._dispatch_batch(wb, wl, None, runner.device))
+    best_rate = 0.0
+    for rep in range(3):
         t0 = time.perf_counter()
         acc = None
-        for _ in range(10):
-            s = runner._dispatch_batch(batch, lengths, None, runner.device)
+        for g in range(rep * 4, rep * 4 + 4):
+            b, l = groups[g]
+            s = runner._dispatch_batch(b, l, None, runner.device)
             acc = s.sum() if acc is None else acc + s.sum()
         float(np.asarray(acc))
-        best = min(best, time.perf_counter() - t0)
-    return 10 * len(docs_b) / best
+        best_rate = max(best_rate, 4 * rows / (time.perf_counter() - t0))
+    return best_rate
 
 
 def run_config(num: int) -> dict:

@@ -354,7 +354,13 @@ def score_batch_onehot(
             hist1, _ = jax.lax.scan(
                 body1, jnp.zeros((B, 256), jnp.float32), (vals, m)
             )
-            total = total + hist1 @ w1.astype(jnp.float32)
+            # HIGHEST: the TPU default for f32 dots is bf16 passes, which
+            # truncates histogram counts and weights (~1e-2 score error —
+            # enough to flip argmax ties; caught by on-chip fuzzing).
+            total = total + jax.lax.dot(
+                hist1, w1.astype(jnp.float32),
+                precision=jax.lax.Precision.HIGHEST,
+            )
         else:
             b0 = b_pad[:, : W + pad] if pad else b_pad[:, :W]
             b1 = jnp.pad(batch[:, 1 : W + 1], ((0, 0), (0, (-W) % block)))
@@ -376,7 +382,10 @@ def score_batch_onehot(
                 body2, jnp.zeros((B, 256, 256), jnp.float32), (b0, b1, m)
             )
             w2 = weights[spec.offsets[2] : spec.offsets[2] + 65536]
-            total = total + hist2.reshape(B, 65536) @ w2.astype(jnp.float32)
+            total = total + jax.lax.dot(
+                hist2.reshape(B, 65536), w2.astype(jnp.float32),
+                precision=jax.lax.Precision.HIGHEST,
+            )
 
         # Partial-window rule (Scala sliding parity): a doc shorter than n
         # contributes its whole-byte prefix once, in the prefix's own length
@@ -387,7 +396,10 @@ def score_batch_onehot(
                 (batch[:, 0].astype(jnp.int32)[:, None] == iota)
                 & is_short[:, None]
             )
-            total = total + short_oh.astype(jnp.float32) @ w1.astype(jnp.float32)
+            total = total + jax.lax.dot(
+                short_oh.astype(jnp.float32), w1.astype(jnp.float32),
+                precision=jax.lax.Precision.HIGHEST,
+            )
     return total
 
 

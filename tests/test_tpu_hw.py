@@ -174,3 +174,46 @@ def test_cuckoo_scorer_matches_host_on_hardware():
     if "skip" in result:
         pytest.skip(result["skip"])
     assert result["max_abs_err"] < 1e-2
+
+
+_ONEHOT_SCRIPT = r"""
+import json, sys
+import numpy as np
+import jax, jax.numpy as jnp
+
+if jax.default_backend() == "cpu":
+    print(json.dumps({"skip": "no accelerator"}))
+    sys.exit(0)
+
+from spark_languagedetector_tpu.ops import score as S
+from spark_languagedetector_tpu.ops.encoding import pad_batch
+from spark_languagedetector_tpu.ops.vocab import EXACT, VocabSpec
+
+spec = VocabSpec(EXACT, (1, 2))
+rng = np.random.default_rng(31)
+weights = rng.normal(size=(spec.id_space_size, 33)).astype(np.float32)
+docs = [b"", b"a"] + [
+    bytes(rng.integers(0, 256, int(rng.integers(1, 700)), dtype=np.uint8))
+    for _ in range(30)
+]
+batch, lengths = pad_batch(docs, pad_to=1024)
+got = np.asarray(S.score_batch_onehot(
+    jnp.asarray(batch), jnp.asarray(lengths), jnp.asarray(weights), spec=spec
+))
+want = S.score_batch_numpy(docs, weights, None, spec)  # dense mode
+err = float(np.abs(got - want).max())
+print(json.dumps({"max_abs_err": err}))
+"""
+
+
+def test_onehot_scorer_matches_host_on_hardware():
+    """The onehot einsum path must score at full f32 precision on TPU.
+
+    Regression for the default-matmul-precision bug: `hist @ W` at the TPU
+    default (bf16 passes) drifted scores by ~1e-2..0.24 — enough to flip
+    argmax near ties. All scoring dots pin Precision.HIGHEST.
+    """
+    result = _run_on_device(_ONEHOT_SCRIPT)
+    if "skip" in result:
+        pytest.skip(result["skip"])
+    assert result["max_abs_err"] < 1e-3

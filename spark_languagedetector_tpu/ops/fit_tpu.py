@@ -83,12 +83,40 @@ def weights_from_counts(counts: jnp.ndarray, *, weight_mode: str = "parity") -> 
 def top_k_rows(weights: jnp.ndarray, *, k: int) -> jnp.ndarray:
     """Per-language top-k row indices over the dense table: int32 [L, k].
 
-    ``lax.top_k`` breaks ties by lowest index — deterministic, and documented
-    as this framework's tie rule (the reference's tie order is
-    partition-dependent, SURVEY.md §2.9).
+    Tie rule: lowest gram id wins (this framework's documented rule; the
+    reference's tie order is partition-dependent, SURVEY.md §2.9). The
+    parity weight formula produces huge equal-weight plateaus, and the TPU
+    lowering of ``lax.top_k`` does NOT honor the lowest-index-first tie
+    order its CPU lowering exhibits (found by on-chip fit fuzzing — host
+    and device fits picked different plateau members). So the boundary
+    plateau is re-ranked explicitly:
+
+    1. value top-k: the k-th value ``w*`` is the boundary; entries with
+       value > w* are winners outright (they occupy a sorted-descending
+       prefix of the result, in whatever order — ties above the boundary
+       are impossible to place wrongly since every strictly-above entry is
+       selected).
+    2. an int32 top-k over ``-id`` restricted to the ``== w*`` plateau
+       yields its members lowest-id-first; the remaining ``k - n_above``
+       slots are filled from it. The plateau always has at least that many
+       members, so every filled slot is valid.
+
+    Integer keys (not f32 -id) keep id order exact beyond 2^24.
     """
-    _, idx = jax.lax.top_k(weights.T, k)  # [L, k]
-    return idx.astype(jnp.int32)
+    wT = weights.T  # [L, V]
+    V = wT.shape[1]
+    vals, idx = jax.lax.top_k(wT, k)
+    w_star = vals[:, k - 1 : k]  # [L, 1] boundary value
+    n_above = (wT > w_star).sum(axis=1, keepdims=True)  # [L, 1], <= k
+    iota = jnp.arange(V, dtype=jnp.int32)[None, :]
+    plateau_key = jnp.where(
+        wT == w_star, -iota, jnp.iinfo(jnp.int32).min
+    )
+    _, pidx = jax.lax.top_k(plateau_key, k)  # plateau ids, ascending
+    j = jnp.arange(k, dtype=jnp.int32)[None, :]
+    shifted = jnp.clip(j - n_above, 0, k - 1)
+    plateau_rows = jnp.take_along_axis(pidx, shifted, axis=1)
+    return jnp.where(j < n_above, idx, plateau_rows).astype(jnp.int32)
 
 
 def fit_dense_step(
@@ -205,7 +233,7 @@ def fit_profile_device(
     # grams seen in training); mask them below any real weight for top-k.
     masked = jnp.where(occurred[:, None], dense_w, -jnp.inf)
     k = min(profile_size, V)
-    top = top_k_rows(masked, k=k)  # [L, k]; lax.top_k ties → lowest id
+    top = top_k_rows(masked, k=k)  # [L, k]; ties → lowest id (re-ranked)
 
     top_np = np.unique(np.asarray(top).reshape(-1))
     occurred_np = np.asarray(occurred[jnp.asarray(top_np)])

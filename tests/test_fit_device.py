@@ -123,3 +123,29 @@ def test_estimator_device_fit_exact_long_grams_matches_cpu():
         dev.transform(Table({"fulltext": texts})).column("lang").tolist()
         == cpu.transform(Table({"fulltext": texts})).column("lang").tolist()
     )
+
+
+def test_top_k_rows_breaks_ties_by_lowest_id():
+    """The boundary tie plateau must resolve lowest-id-first on EVERY
+    backend. The TPU lowering of lax.top_k does not honor lowest-index
+    ties (found by on-chip fit fuzzing: host and device fits selected
+    different members of the log(2) parity plateau), so top_k_rows
+    re-ranks the plateau explicitly with integer keys."""
+    import jax.numpy as jnp
+
+    from spark_languagedetector_tpu.ops.fit_tpu import top_k_rows
+
+    rng = np.random.default_rng(5)
+    V, L, k = 512, 3, 20
+    w = np.full((V, L), -np.inf, dtype=np.float32)
+    for lang in range(L):
+        # 5 strictly-above winners at distinct weights, scattered high ids
+        strong = rng.choice(np.arange(200, V), size=5, replace=False)
+        w[strong, lang] = 10.0 + np.arange(5)
+        # a 100-member tie plateau crossing the boundary (only 15 slots left)
+        plateau = rng.choice(np.arange(V), size=100, replace=False)
+        plateau = plateau[~np.isin(plateau, strong)]
+        w[plateau, lang] = np.float32(0.6931472)
+        rows = np.asarray(top_k_rows(jnp.asarray(w), k=k))[lang]
+        want = set(strong.tolist()) | set(sorted(plateau.tolist())[: k - 5])
+        assert set(rows.tolist()) == want, f"lang {lang}"

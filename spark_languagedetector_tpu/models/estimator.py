@@ -101,6 +101,14 @@ class _DetectorParams(HasInputCol, HasLabelCol):
         "(micro-batched scatter-add + device weighting/top-k)",
         lambda v: v in ("cpu", "device"),
     )
+    backend = Param(
+        "backend",
+        "scoring backend stamped onto the fitted model "
+        "(LanguageDetectorModel.backend — 'tpu' | 'cpu' | 'auto' | 'mesh' "
+        "| 'mesh:vocab'); set here so the Spark-style "
+        "estimator-configures-model flow works in one place",
+        lambda v: v in BACKENDS,
+    )
 
 
 class LanguageDetector(_DetectorParams):
@@ -138,6 +146,9 @@ class LanguageDetector(_DetectorParams):
 
     def set_fit_backend(self, value: str):
         return self.set("fitBackend", value)
+
+    def set_backend(self, value: str):
+        return self.set("backend", value)
 
     def set_vocab_mode(self, mode: str):
         return self.set("vocabMode", mode)
@@ -271,6 +282,8 @@ class LanguageDetector(_DetectorParams):
 
         model = LanguageDetectorModel(profile)
         model.set_default(inputCol=self.get_or_default("inputCol"))
+        if self.is_set("backend"):
+            model.set("backend", self.get("backend"))
         return model
 
 

@@ -181,3 +181,20 @@ def test_save_grams_to(tmp_path):
     table = pq.read_table(path + "/part-00000.parquet")
     assert table.num_rows == 10
     assert set(table.column_names) == {"gram", "probabilities"}
+
+
+def test_estimator_backend_propagates_to_model():
+    """The README quickstart configures the backend on the estimator
+    (Spark-style: estimator params flow to the fitted model); an unset
+    estimator leaves the model's 'auto' default untouched."""
+    table = Table({
+        "lang": ["de", "en"],
+        "fulltext": ["der hund schön über", "the dog nice with"],
+    })
+    m = (
+        LanguageDetector(["de", "en"], [2], 50)
+        .set_backend("cpu")
+        .fit(table)
+    )
+    assert m.get("backend") == "cpu"
+    assert not LanguageDetector(["de", "en"], [2], 50).fit(table).is_set("backend")

@@ -77,6 +77,13 @@ DEFAULT_HYBRID_BATCH_SIZE = 4096
 # is smaller. A/B on the config-3 corpus (8k docs, tunneled v5e):
 # 4096 -> 14.8k docs/s, 2048 -> 20.7k, 1024 -> 24.6k end-to-end.
 DEFAULT_HEAVY_BATCH_SIZE = 1024
+# Default concurrent dispatch threads for single-device batch execution
+# (BatchRunner.dispatch_workers=None). Measured on the tunneled v5e
+# (interleaved A/B, 6-8 rounds/config, docs/PERFORMANCE.md §4): the serial
+# async-dispatch pipeline already saturates the wire — 3 workers landed at
+# 0.93-0.95× the serial median on configs 1/2/3 — so the default stays 1;
+# the knob remains for other link profiles (e.g. co-located PCIe).
+DISPATCH_WORKERS = 1
 # Hard cap on a single micro-batch's padded bytes. Once a program has
 # executed, h2d transfers ride the real device link (a tunneled relay here:
 # ~30-90MB/s, bursty; pre-execution puts only stage locally and measure
@@ -85,6 +92,18 @@ DEFAULT_HEAVY_BATCH_SIZE = 1024
 # batches (coarser transfer/compute overlap) — 0.37s vs 0.48-0.71s per
 # 20k-doc pass.
 MAX_BATCH_BYTES = 8 << 20
+
+
+def rows_for_bucket(pad_to: int, batch_size: int) -> int:
+    """Micro-batch row count for a padded width: ``batch_size`` halved until
+    the padded transfer fits MAX_BATCH_BYTES (64-row floor). The single
+    policy site — `BatchRunner._execute` plans with it and `bench.py`'s
+    compute-only measurement reuses it so the timed shape can't drift from
+    what the runner actually dispatches."""
+    rows = batch_size
+    while rows * pad_to > MAX_BATCH_BYTES and rows > 64:
+        rows //= 2
+    return rows
 
 
 def resolve_device(backend: str):
@@ -193,6 +212,13 @@ class BatchRunner:
     # vocabs with gram lengths > 3 — routed through the gather-style
     # dispatch with packed-key lookups instead of a LUT.
     cuckoo: object | None = None
+    # Concurrent dispatch threads for the batch path: one worker's
+    # pack+device_put hides another's tunnel round-trip, the same overlap
+    # the streaming engine's transform workers buy (stream.microbatch).
+    # None ⇒ auto: DISPATCH_WORKERS on single-device dispatch, 1 on a mesh —
+    # in a multi-process mesh every process must enqueue collective programs
+    # in the same order, and concurrent workers would make that order racy.
+    dispatch_workers: int | None = None
     metrics: Metrics = field(default_factory=Metrics)
 
     def __post_init__(self):
@@ -843,10 +869,7 @@ class BatchRunner:
             by_bucket.setdefault(b, []).append(k)
 
         def rows_for(pad_to: int) -> int:
-            rows = self.batch_size
-            while rows * pad_to > MAX_BATCH_BYTES and rows > 64:
-                rows //= 2
-            return rows
+            return rows_for_bucket(pad_to, self.batch_size)
 
         plan: list[tuple[np.ndarray, int]] = []
         carry: list[int] = []
@@ -951,25 +974,44 @@ class BatchRunner:
             sub = scores[jnp.asarray(pos)] if pos.size else None
             return am, sub, pos
 
-        pending: list[tuple] = []
+        def run_one(item):
+            """Pack, dispatch, and project one planned batch (retry once on
+            transient failure). Async dispatch: the device works while other
+            batches pack. Only (sel, pad_to) is retained for replay — the
+            padded arrays are rebuilt from `chunks` in the rare
+            fetch-failure path, so peak host RSS stays O(workers × batch),
+            not O(corpus)."""
+            sel, pad_to = item
+            try:
+                scores = build_and_dispatch(sel, pad_to)
+            except RETRYABLE as e:
+                log_event(_log, "runner.retry", rows=len(sel), error=repr(e))
+                self.metrics.incr("retries")
+                scores = build_and_dispatch(sel, pad_to)
+            self.metrics.incr("chunks_scored", len(sel))
+            if want_labels:
+                return (sel, project(sel, scores), pad_to)
+            return (sel, scores, pad_to)
+
+        # Concurrent dispatch: pack/put/dispatch are dominated by
+        # GIL-releasing work (native packer, PJRT transfer round-trips), so
+        # a few workers overlap one batch's wire latency with another's
+        # packing — the batch-path analog of the streaming engine's
+        # transform workers. Results keep plan order (ex.map). Mesh
+        # dispatch stays single-threaded by default: multi-process GSPMD
+        # requires identical collective enqueue order across processes.
+        workers = self.dispatch_workers
+        if workers is None:
+            workers = DISPATCH_WORKERS if self.mesh is None else 1
+        workers = max(1, min(workers, len(plan)))
         with trace(), self.metrics.timer("score_s"):
-            for sel, pad_to in plan:
-                try:
-                    scores = build_and_dispatch(sel, pad_to)
-                except RETRYABLE as e:
-                    log_event(_log, "runner.retry", rows=len(sel), error=repr(e))
-                    self.metrics.incr("retries")
-                    scores = build_and_dispatch(sel, pad_to)
-                # Async dispatch: keep packing while the device works. Only
-                # (sel, pad_to) is retained for replay — the padded arrays
-                # are rebuilt from `chunks` in the rare fetch-failure path,
-                # so peak host RSS stays O(one batch), not O(corpus).
-                if want_labels:
-                    am, sub, pos = project(sel, scores)
-                    pending.append((sel, (am, sub, pos), pad_to))
-                else:
-                    pending.append((sel, scores, pad_to))
-                self.metrics.incr("chunks_scored", len(sel))
+            if workers > 1:
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(max_workers=workers) as ex:
+                    pending = list(ex.map(run_one, plan))
+            else:
+                pending = [run_one(item) for item in plan]
 
             # Results stream back asynchronously: each batch's d2h copy is
             # started as soon as every batch is dispatched (payloads are tiny

@@ -471,7 +471,11 @@ class LanguageDetectorModel(HasInputCol, HasOutputCol):
         runner = self._get_runner()
         detected = runner.predict(docs, self.profile.languages)
         result = dataset.with_column(self.get_output_col(), detected, STRING)
-        assert result.schema == out_schema, (result.schema, out_schema)
+        if result.schema != out_schema:
+            raise RuntimeError(
+                "transform produced a schema that disagrees with "
+                f"transform_schema: {result.schema} != {out_schema}"
+            )
         return result
 
     def detect(self, text: str) -> str:

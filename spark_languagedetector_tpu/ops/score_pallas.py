@@ -307,7 +307,12 @@ def score_batch_pallas(
     pre-shaped by :func:`weight_views`. ``interpret=True`` runs the kernel in
     Pallas interpret mode (any backend — used by the CPU tests).
     """
-    assert spec.mode == EXACT and max(spec.gram_lengths) <= 2
+    if spec.mode != EXACT or max(spec.gram_lengths) > 2:
+        raise ValueError(
+            "score_batch_pallas supports exact-mode vocabularies with gram "
+            f"lengths <= 2 only; got mode={spec.mode!r} "
+            f"gram_lengths={spec.gram_lengths!r}"
+        )
     has1 = 1 in spec.gram_lengths
     has2 = 2 in spec.gram_lengths
     B0, S0 = batch.shape

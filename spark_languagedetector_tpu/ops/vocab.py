@@ -96,7 +96,8 @@ def exact_space_size(gram_lengths: Sequence[int]) -> int:
 # fold region starting at the combined space size). Every id-computation site
 # (gram_to_id, window_ids, window_ids_numpy, prefix_hashes) reads these.
 _SHORT_GRAM_OFFSETS = exact_offsets((1, 2))
-assert _EXACT12_BASE == exact_space_size((1, 2))
+if _EXACT12_BASE != exact_space_size((1, 2)):  # pragma: no cover
+    raise AssertionError("exact12 layout constant drifted from exact layout")
 
 
 @dataclass(frozen=True)

@@ -55,3 +55,55 @@ def test_ascii_lower():
     assert native.ascii_lower(b"Hello WORLD 123") == b"hello world 123"
     s = "ÄÖÜ".encode("utf-8")
     assert native.ascii_lower(s) == s  # non-ASCII untouched
+
+
+def test_refscorer_matches_oracle():
+    """The compiled baseline scorer (bench.py's vs_cpp denominator) is the
+    same computation as the reference-semantics oracle: identical map,
+    identical window rules (incl. the partial-window-per-length rule),
+    identical double accumulation order, first-max-wins argmax."""
+    from .oracle import detect_oracle
+
+    rng = np.random.default_rng(7)
+    langs = ["aa", "bb", "cc"]
+    alphabet = b"abcd"
+    gram_lengths = [1, 2, 3]
+    gram_map = {}
+    for _ in range(60):
+        n = int(rng.integers(1, 4))
+        g = bytes(rng.choice(list(alphabet), n))
+        gram_map[g] = [float(x) for x in rng.normal(size=len(langs))]
+    keys = list(gram_map)
+    rs = native.RefScorer(keys, np.asarray([gram_map[k] for k in keys]))
+    try:
+        docs = [
+            bytes(rng.choice(list(alphabet), int(rng.integers(0, 30))))
+            for _ in range(200)
+        ]
+        docs += [b"", b"a", b"ab"]  # partial-window and empty edges
+        got = rs.score(docs, gram_lengths)
+        for d, label in zip(docs, got.tolist()):
+            want = detect_oracle(
+                d.decode("latin-1"), gram_map, langs, gram_lengths,
+                encoding="lowbyte",
+            )
+            assert langs[label] == want, d
+    finally:
+        rs.close()
+
+
+def test_refscorer_multithreaded_matches_single():
+    rng = np.random.default_rng(8)
+    keys = [b"ab", b"bc", b"c", b"abc"]
+    vecs = rng.normal(size=(4, 5))
+    rs = native.RefScorer(keys, vecs)
+    try:
+        docs = [
+            bytes(rng.choice(list(b"abc"), int(rng.integers(0, 40))))
+            for _ in range(300)
+        ]
+        np.testing.assert_array_equal(
+            rs.score(docs, [1, 2, 3]), rs.score(docs, [1, 2, 3], n_threads=4)
+        )
+    finally:
+        rs.close()

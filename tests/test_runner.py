@@ -100,6 +100,32 @@ def test_predict_ids_matches_host_argmax_with_chunked_docs():
     assert ids[len(docs) - 3] == 0  # empty doc -> first language (Q6)
 
 
+def test_dispatch_workers_bitwise_identical():
+    """Concurrent dispatch (dispatch_workers > 1) must return exactly what
+    serial dispatch returns — same plan, same batches, plan-ordered
+    results — for both the score and label paths, chunked docs included."""
+    rng = np.random.default_rng(41)
+    spec = VocabSpec(EXACT, (1, 2))
+    weights = rng.normal(size=(spec.id_space_size, 4)).astype(np.float32)
+
+    def make(workers):
+        return BatchRunner(
+            weights=jnp.asarray(weights), lut=None, spec=spec,
+            strategy="gather", length_buckets=(32, 64), batch_size=4,
+            dispatch_workers=workers,
+        )
+
+    docs = [
+        bytes(rng.integers(97, 122, rng.integers(0, 80)).tolist())
+        for _ in range(37)
+    ] + [b"", bytes(b"xy" * 200)]  # empty + chunked (> 64)
+    serial, threaded = make(1), make(4)
+    np.testing.assert_array_equal(serial.score(docs), threaded.score(docs))
+    np.testing.assert_array_equal(
+        serial.predict_ids(docs), threaded.predict_ids(docs)
+    )
+
+
 def test_predict_ids_mesh(eight_devices):
     """Label path under a data-parallel mesh (pad rows dropped)."""
     rng = np.random.default_rng(33)

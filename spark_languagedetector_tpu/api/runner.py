@@ -114,14 +114,17 @@ def resolve_device(backend: str):
     """
     if backend == "auto":
         return None
+    # Single-device backends pick a LOCAL device: under jax.distributed,
+    # jax.devices() leads with process 0's devices, which other processes
+    # cannot copy to — only the mesh backends ever span processes.
     if backend == "cpu":
-        return jax.devices("cpu")[0]
-    for d in jax.devices():
+        return jax.local_devices(backend="cpu")[0]
+    for d in jax.local_devices():
         if d.platform != "cpu":
             return d
     raise RuntimeError(
         f"backend={backend!r} requested but no accelerator device is "
-        f"visible (have {[d.platform for d in jax.devices()]})"
+        f"visible (have {[d.platform for d in jax.local_devices()]})"
     )
 
 
@@ -725,6 +728,24 @@ class BatchRunner:
             interpret=interpret,
         )
 
+    def _fetch(self, arr) -> np.ndarray:
+        """Host numpy value of one result array.
+
+        On a multi-process mesh (jax.distributed — SURVEY §2.3's multi-host
+        leg) the data-axis shards of a result live on other processes'
+        devices, so plain ``np.asarray`` would raise on non-addressable
+        shards; ``process_allgather`` assembles the global value on every
+        process instead (every process calls it for every batch in the same
+        plan order, so the collective schedule is identical process-wide).
+        Single-process: a plain copy."""
+        if self.mesh is not None and jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(
+                multihost_utils.process_allgather(arr, tiled=True)
+            )
+        return np.asarray(arr)
+
     @staticmethod
     def _pack(batch_docs, pad_to: int):
         """Padded packing: native C++ loader (falls back to numpy internally)."""
@@ -1019,8 +1040,11 @@ class BatchRunner:
             # so result transfer overlaps the remaining compute instead of
             # serializing after it. A blocking per-batch np.asarray here
             # would instead pay the full device-sync latency once per batch
-            # (measured ~8ms over a tunneled TPU).
-            for _, s, _ in pending:
+            # (measured ~8ms over a tunneled TPU). Multi-process meshes skip
+            # the prefetch: results are assembled via process_allgather in
+            # _fetch, and a host copy of non-addressable shards can't start.
+            multiproc = self.mesh is not None and jax.process_count() > 1
+            for _, s, _ in pending if not multiproc else ():
                 arrays = (s,) if not want_labels else (s[0], s[1])
                 for a in arrays:
                     if a is None:
@@ -1036,14 +1060,20 @@ class BatchRunner:
                 try:
                     if want_labels:
                         am, sub, pos = s
-                        am_host = np.asarray(am)
-                        sub_host = None if sub is None else np.asarray(sub)
+                        am_host = self._fetch(am)
+                        sub_host = None if sub is None else self._fetch(sub)
                     else:
-                        host = np.asarray(s)
+                        host = self._fetch(s)
                 except RETRYABLE as e:
                     # A failure surfacing only at fetch time (async dispatch
                     # defers execution errors here): replay the batch once,
-                    # synchronously.
+                    # synchronously. NOT on a multi-process mesh: a replay
+                    # enqueues fresh collectives on this process alone,
+                    # desynchronizing the process-wide collective schedule
+                    # _fetch depends on — propagate instead (the caller's
+                    # whole call is replayable on every process together).
+                    if multiproc:
+                        raise
                     log_event(
                         _log, "runner.retry_fetch", rows=len(sel), error=repr(e)
                     )
@@ -1051,10 +1081,10 @@ class BatchRunner:
                     scores = build_and_dispatch(sel, pad_to)
                     if want_labels:
                         am, sub, pos = project(sel, scores)
-                        am_host = np.asarray(am)
-                        sub_host = None if sub is None else np.asarray(sub)
+                        am_host = self._fetch(am)
+                        sub_host = None if sub is None else self._fetch(sub)
                     else:
-                        host = np.asarray(scores)
+                        host = self._fetch(scores)
                 # Rows beyond len(sel) are mesh pad rows — dropped here.
                 if want_labels:
                     docs_of = doc_idx_arr[sel]

@@ -153,3 +153,88 @@ def test_stream_explicit_single_worker_preserves_order():
     )
     assert query.batches == 5
     assert outputs == ["a", "x"] * 10
+
+
+# --------------------------------------------------- kafka source (stubbed) --
+class _FakeRecord:
+    def __init__(self, value):
+        self.value = value
+
+
+class _FakeConsumer:
+    """Scripted kafka.KafkaConsumer stand-in: each poll() returns the next
+    scripted {partition: [records]} dict ({} once the script runs out)."""
+
+    instances: list = []
+
+    def __init__(self, topic, **kwargs):
+        self.topic = topic
+        self.kwargs = kwargs
+        self.polls = list(self.script)
+        _FakeConsumer.instances.append(self)
+
+    def poll(self, timeout_ms):
+        self.poll_timeout_ms = timeout_ms
+        return self.polls.pop(0) if self.polls else {}
+
+
+@pytest.fixture
+def fake_kafka(monkeypatch):
+    """Install a fake `kafka` module so kafka_source's consumer loop runs."""
+    import sys
+    import types
+
+    mod = types.ModuleType("kafka")
+    mod.KafkaConsumer = _FakeConsumer
+    _FakeConsumer.instances = []
+    monkeypatch.setitem(sys.modules, "kafka", mod)
+    return mod
+
+
+def test_kafka_source_batches_decodes_and_flushes(fake_kafka):
+    """One poll round: full batches yield as they fill; the round's ragged
+    tail flushes after the poll; an empty poll yields nothing."""
+    from itertools import islice
+
+    _FakeConsumer.script = [
+        {
+            "tp0": [
+                _FakeRecord(b"hello"),
+                _FakeRecord(b"welt"),
+                _FakeRecord("already-a-str"),
+            ],
+            "tp1": [_FakeRecord(b"\xff\xferaw")],  # invalid UTF-8 -> replace
+        },
+        {},  # empty poll round: nothing buffered, nothing yielded
+        {"tp0": [_FakeRecord(12345)]},  # non-bytes non-str -> str()
+    ]
+    src = kafka_source(
+        "mytopic", batch_rows=2, poll_timeout_s=0.5, group_id="g1"
+    )
+    tables = list(islice(src, 3))
+    assert [t.column("fulltext").tolist() for t in tables] == [
+        ["hello", "welt"],
+        ["already-a-str", "��raw"],  # tail flush of round 1
+        ["12345"],  # round 3's tail flush
+    ]
+    (consumer,) = _FakeConsumer.instances
+    assert consumer.topic == "mytopic"
+    assert consumer.kwargs == {"group_id": "g1"}
+    assert consumer.poll_timeout_ms == 500
+
+
+def test_kafka_source_drives_run_stream(fake_kafka):
+    """End-to-end: kafka source -> engine -> sink, bounded by max_batches."""
+    _FakeConsumer.script = [
+        {"tp": [_FakeRecord(b"ababab"), _FakeRecord(b"xyxy")]},
+        {"tp": [_FakeRecord(b"abab")]},
+    ]
+    outputs = []
+    query = run_stream(
+        _model(),
+        kafka_source("t", batch_rows=2),
+        sink=lambda t: outputs.extend(t.column("lang").tolist()),
+        max_batches=2,
+    )
+    assert query.batches == 2
+    assert outputs == ["a", "x", "a"]

@@ -206,6 +206,121 @@ print(json.dumps({"max_abs_err": err}))
 """
 
 
+_HIST_E2E_SCRIPT = r"""
+import json, sys
+import numpy as np
+import jax, jax.numpy as jnp
+
+if jax.default_backend() == "cpu":
+    print(json.dumps({"skip": "no accelerator"}))
+    sys.exit(0)
+
+from spark_languagedetector_tpu.api.runner import BatchRunner
+from spark_languagedetector_tpu.models.profile import GramProfile
+from spark_languagedetector_tpu.ops.vocab import EXACT, VocabSpec
+
+# Exact n=1..5 profile (config-3 shape): membership is cuckoo-derived, so
+# strategy='hist' runs the single-probe bucket table + histogram kernel —
+# the path the long-gram bench configs actually execute on chip.
+spec = VocabSpec(EXACT, (1, 2, 3, 4, 5))
+rng = np.random.default_rng(37)
+docs = [b"", b"a", b"abcd"] + [
+    bytes(rng.integers(97, 107, int(rng.integers(1, 600)), dtype=np.uint8))
+    for _ in range(29)
+]
+grams = sorted({d[i:i+n] for d in docs[3:20] for n in (1, 2, 3, 4, 5)
+                for i in range(max(len(d) - n + 1, 0))})[:4000]
+ids = np.asarray(sorted(spec.gram_to_id(g) for g in grams), np.int64)
+profile = GramProfile(
+    spec=spec, languages=tuple(f"l{i}" for i in range(7)),
+    ids=ids, weights=rng.normal(size=(len(ids), 7)).astype(np.float32),
+)
+w, lut, cuckoo = profile.device_membership()
+
+def make(strategy):
+    return BatchRunner(
+        weights=w, lut=lut, spec=spec, cuckoo=cuckoo, strategy=strategy,
+        length_buckets=(128, 256, 512), batch_size=16,
+    )
+
+hist, gather = make("hist"), make("gather")
+assert hist._hist_state() is not None and hist._hist_state()[3] is not None, \
+    "expected bucket membership (cuckoo-derived), got LUT fallback"
+gs = np.asarray(gather.score(docs))
+hs = np.asarray(hist.score(docs))
+err = float(np.abs(hs - gs).max())
+labels_equal = bool((hist.predict_ids(docs) == np.argmax(gs, axis=1)).all())
+print(json.dumps({"max_abs_err": err, "labels_equal": labels_equal}))
+"""
+
+
+def test_hist_strategy_end_to_end_on_hardware():
+    """BatchRunner(strategy='hist') — single-probe bucket membership composed
+    with the histogram kernel, n=1..5 — against the gather escape hatch on
+    chip, through the full plan/pack/dispatch/label pipeline."""
+    result = _run_on_device(_HIST_E2E_SCRIPT)
+    if "skip" in result:
+        pytest.skip(result["skip"])
+    assert result["max_abs_err"] < 1e-2
+    assert result["labels_equal"]
+
+
+_HYBRID_E2E_SCRIPT = r"""
+import json, sys
+import numpy as np
+import jax, jax.numpy as jnp
+
+if jax.default_backend() == "cpu":
+    print(json.dumps({"skip": "no accelerator"}))
+    sys.exit(0)
+
+from spark_languagedetector_tpu.api.runner import BatchRunner
+from spark_languagedetector_tpu.models.profile import GramProfile
+from spark_languagedetector_tpu.ops.vocab import EXACT, VocabSpec
+
+# Exact n=1..3 with a compact profile: strategy='hybrid' scores n<=2 through
+# the pallas histogram kernel over the dense sub-table and n=3 through the
+# gather path — the auto choice for config 2's shape on TPU.
+spec = VocabSpec(EXACT, (1, 2, 3))
+rng = np.random.default_rng(41)
+docs = [b"", b"ab"] + [
+    bytes(rng.integers(97, 110, int(rng.integers(1, 500)), dtype=np.uint8))
+    for _ in range(30)
+]
+grams = sorted({d[i:i+n] for d in docs[2:20] for n in (1, 2, 3)
+                for i in range(max(len(d) - n + 1, 0))})[:3000]
+ids = np.asarray(sorted(spec.gram_to_id(g) for g in grams), np.int64)
+profile = GramProfile(
+    spec=spec, languages=tuple(f"l{i}" for i in range(5)),
+    ids=ids, weights=rng.normal(size=(len(ids), 5)).astype(np.float32),
+)
+w, lut, cuckoo = profile.device_membership()
+
+def make(strategy):
+    return BatchRunner(
+        weights=w, lut=lut, spec=spec, cuckoo=cuckoo, strategy=strategy,
+        length_buckets=(128, 256, 512), batch_size=16,
+    )
+
+hybrid, gather = make("hybrid"), make("gather")
+gs = np.asarray(gather.score(docs))
+hs = np.asarray(hybrid.score(docs))
+err = float(np.abs(hs - gs).max())
+labels_equal = bool((hybrid.predict_ids(docs) == np.argmax(gs, axis=1)).all())
+print(json.dumps({"max_abs_err": err, "labels_equal": labels_equal}))
+"""
+
+
+def test_hybrid_strategy_end_to_end_on_hardware():
+    """BatchRunner(strategy='hybrid') — pallas short-gram kernel + long-gram
+    gather — against the pure gather strategy on chip."""
+    result = _run_on_device(_HYBRID_E2E_SCRIPT)
+    if "skip" in result:
+        pytest.skip(result["skip"])
+    assert result["max_abs_err"] < 1e-2
+    assert result["labels_equal"]
+
+
 def test_onehot_scorer_matches_host_on_hardware():
     """The onehot einsum path must score at full f32 precision on TPU.
 

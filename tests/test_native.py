@@ -107,3 +107,44 @@ def test_refscorer_multithreaded_matches_single():
         )
     finally:
         rs.close()
+
+
+def test_bench_cpp_key_vecs_hashed_reconstruction():
+    """bench._cpp_key_vecs reconstructs a string-keyed map for hashed
+    profiles from the training corpus: every harvested gram's bucket id is
+    in the profile, its vector is that bucket's row, and every training
+    gram whose bucket survived selection is present (no silent drops)."""
+    import bench
+    from spark_languagedetector_tpu import LanguageDetector, Table
+
+    cfg = dict(
+        n_langs=3, gram_lengths=[1, 2, 3], k=50, vocab="hashed",
+        train_per_lang=4, label="t",
+    )
+    langs = bench.language_names(cfg["n_langs"])
+    docs, labels = bench.make_corpus(
+        langs, cfg["train_per_lang"] * len(langs), seed=1
+    )
+    det = LanguageDetector(langs, cfg["gram_lengths"], cfg["k"]) \
+        .set_vocab_mode("hashed").set_hash_bits(20)
+    model = det.fit(Table({"lang": labels, "fulltext": docs}))
+    keys, vecs = bench._cpp_key_vecs(model, cfg)
+    assert len(keys) == len(set(keys)) == vecs.shape[0] > 0
+
+    prof = model.profile
+    spec = prof.spec
+    row_of = {int(i): r for r, i in enumerate(prof.ids)}
+    for k_, v in zip(keys, vecs):
+        r = row_of[spec.gram_to_id(k_)]  # KeyError = harvested a non-member
+        np.testing.assert_array_equal(v, prof.weights[r])
+
+    # Completeness: every member gram of the training corpus is harvested.
+    want = set()
+    for d in docs:
+        b = d.encode("utf-8")
+        for n in spec.gram_lengths:
+            for i in range(max(len(b) - n + 1, 0)):
+                g = b[i : i + n]
+                if spec.gram_to_id(g) in row_of:
+                    want.add(g)
+    assert want == set(keys)

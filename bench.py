@@ -44,9 +44,11 @@ Environment knobs:
     BENCH_CONFIGS        comma list, default "2,3,4,5,1" (1 last = headline)
     BENCH_DOCS           override eval-doc count for every config
     BENCH_BASELINE_DOCS  override baseline/parity-doc count for every config
-    BENCH_SOFT_BUDGET_S  soft wall-clock budget (default 720): once spent,
+    BENCH_SOFT_BUDGET_S  soft wall-clock budget (default 1200): once spent,
                          intermediate configs are skipped (noted on stderr)
-                         so the final/headline config always runs
+                         so the final/headline config always runs; the
+                         additive legs (accuracy legs, hashed-vs-exact)
+                         skip first, when under ~2-4 min remain
     SLD_TPU_TESTS        "1" => also run the real-TPU parity suite
                          (tests/test_tpu_hw.py) after the headline config,
                          reporting to stderr (stdout stays parseable)
@@ -562,7 +564,12 @@ def measure_compute_only(model, eval_docs):
     return best_rate
 
 
-def run_config(num: int) -> dict:
+def run_config(num: int, deadline: float | None = None) -> dict:
+    """One config's full measurement. ``deadline`` (perf_counter value) gates
+    the ADDITIVE legs only — accuracy legs and the config-5 hashed-vs-exact
+    comparison are skipped with a marker when the soft budget is nearly
+    spent, so the core metrics (value + parity gate + denominators) always
+    complete for every config the budget admits at all."""
     from concurrent.futures import ThreadPoolExecutor
 
     cfg = CONFIGS[num]
@@ -723,9 +730,21 @@ def run_config(num: int) -> dict:
             result["compute_docs_per_s"] = round(compute_dps, 1)
         if not cfg.get("streaming"):
             result["strategy"] = model._get_runner().strategy
-        result.update(accuracy_legs(model, cfg, langs))
+        def budget_left(need_s: float) -> bool:
+            return deadline is None or time.perf_counter() + need_s < deadline
+
+        # Additive legs (new shapes compile ~20-40s each through a remote-
+        # compile tunnel): only when the soft budget has room, so a driver
+        # on the default budget still gets every config's core metrics.
+        if budget_left(120):
+            result.update(accuracy_legs(model, cfg, langs))
+        else:
+            result["accuracy_legs"] = "skipped (soft budget)"
         if num == 5:
-            result.update(hashed_vs_exact(model, cfg, langs))
+            if budget_left(240):
+                result.update(hashed_vs_exact(model, cfg, langs))
+            else:
+                result["hashed_vs_exact"] = "skipped (soft budget)"
         if baseline_dps:
             result["vs_baseline"] = round(device_dps / baseline_dps, 2)
             result["vs_numpy"] = round(device_dps / baseline_np_dps, 2)
@@ -758,8 +777,9 @@ def main():
     # enforces a timeout, the headline config (last in the list) must still
     # run — so once the budget is spent, intermediate configs are skipped
     # (noted on stderr) and the run jumps straight to the final config.
-    budget_s = float(os.environ.get("BENCH_SOFT_BUDGET_S", "720"))
+    budget_s = float(os.environ.get("BENCH_SOFT_BUDGET_S", "1200"))
     t_start = time.perf_counter()
+    deadline = t_start + budget_s
     failures = 0
     summary: dict[int, dict] = {}
     for i, num in enumerate(order):
@@ -773,7 +793,7 @@ def main():
             summary[num] = {"skipped": "soft time budget"}
             continue
         try:
-            result = run_config(num)
+            result = run_config(num, deadline=deadline)
             print(json.dumps(result), flush=True)
             summary[num] = {
                 k: result[k]

@@ -44,7 +44,7 @@ Environment knobs:
     BENCH_CONFIGS        comma list, default "2,3,4,5,1" (1 last = headline)
     BENCH_DOCS           override eval-doc count for every config
     BENCH_BASELINE_DOCS  override baseline/parity-doc count for every config
-    BENCH_SOFT_BUDGET_S  soft wall-clock budget (default 1200): once spent,
+    BENCH_SOFT_BUDGET_S  soft wall-clock budget (default 900): once spent,
                          intermediate configs are skipped (noted on stderr)
                          so the final/headline config always runs; the
                          additive legs (accuracy legs, hashed-vs-exact)
@@ -777,7 +777,7 @@ def main():
     # enforces a timeout, the headline config (last in the list) must still
     # run — so once the budget is spent, intermediate configs are skipped
     # (noted on stderr) and the run jumps straight to the final config.
-    budget_s = float(os.environ.get("BENCH_SOFT_BUDGET_S", "1200"))
+    budget_s = float(os.environ.get("BENCH_SOFT_BUDGET_S", "900"))
     t_start = time.perf_counter()
     deadline = t_start + budget_s
     failures = 0

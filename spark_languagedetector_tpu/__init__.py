@@ -18,7 +18,7 @@ from .api.pipeline import Pipeline, PipelineModel
 from .api.table import Schema, Table
 from .models.language import ISO_LANGUAGE_CODES, Language
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"
 
 __all__ = [
     "ISO_LANGUAGE_CODES",

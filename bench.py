@@ -16,14 +16,19 @@ Wikipedia/CommonCrawl dumps; none are available in this zero-egress image,
 so the baseline is *measured, not cited* (BASELINE.md) on the same synthetic
 corpus for both sides.
 
-Three baseline denominators per config, reported side by side:
+Four baseline denominators per config, reported side by side:
   * ``vs_cpp`` / ``baseline_cpp_docs_per_s`` — a compiled per-row scorer
     with the reference hot loop's exact shape (native/refscorer.cpp:
     hash-map probe per window + double axpy + argmax, -O3, one thread).
     Stronger than the reference's JVM loop (no per-window allocation), so
     this is the LOWER bound on the true vs-Scala-UDF multiple; for exact
     configs its labels must agree with the per-row Python baseline
-    exactly (``cpp_agreement``).
+    exactly (``cpp_agreement``, enforced).
+  * ``vs_cpp_mt`` / ``baseline_cpp_mt_docs_per_s`` — the same compiled
+    scorer with ``os.cpu_count()`` threads: one TPU chip vs one whole
+    multi-core host (the reference's transform is cluster-parallel by
+    contract, so the single-thread number stands in for one executor core
+    and this one for a whole executor host).
   * ``vs_baseline`` / ``baseline_docs_per_s`` — the same per-row
     semantics (per-window dict lookup + vector accumulate,
     LanguageDetectorModel.scala:139-152) in pure Python. Far slower than
@@ -222,6 +227,16 @@ def baseline_score_ids(text: str, bucket_map: dict, spec, num_langs: int):
     return acc
 
 
+def usable_cpus() -> int:
+    """CPUs this process may actually run on — cgroup/taskset-aware, so the
+    multi-thread denominator doesn't oversubscribe (and thus understate the
+    host) in restricted environments."""
+    try:
+        return len(os.sched_getaffinity(0)) or 1
+    except AttributeError:  # non-Linux
+        return os.cpu_count() or 1
+
+
 # ------------------------------------------------- compiled C++ baseline ----
 def _cpp_key_vecs(model, cfg):
     """(keys, vecs) for the compiled reference-shape baseline's gram map.
@@ -275,13 +290,20 @@ def _cpp_key_vecs(model, cfg):
 
 
 def time_cpp_baseline(model, cfg, sub):
-    """(docs/s single-thread, labels, map size) for the compiled baseline.
+    """(docs/s single-thread, docs/s multi-thread, labels, map size) for the
+    compiled baseline.
 
     Times the C++ scorer over the parity subset (best of >= 3 reps or 0.5s
     of wall clock, whichever is more) on one thread — the per-row-executor
-    stand-in for the reference's JVM UDF hot loop. Returns (None, None, None)
-    when the native library is unavailable (bench still reports the Python
-    denominators)."""
+    stand-in for the reference's JVM UDF hot loop — and once more with
+    ``os.cpu_count()`` threads (``vs_cpp_mt``: the whole-host denominator,
+    since the reference's transform is cluster-parallel by contract).
+    Methodology note: best-of-reps favors the C++ side relative to the
+    single-pass pure-Python denominator in time_baselines — the asymmetry
+    DEFLATES vs_cpp (conservative for the device's claim), and is kept
+    because the C++ pass is cheap enough to repeat while the Python pass
+    costs minutes. Returns (None, None, None, None) when the native library
+    is unavailable (bench still reports the Python denominators)."""
     try:
         from spark_languagedetector_tpu import native
 
@@ -293,20 +315,26 @@ def time_cpp_baseline(model, cfg, sub):
             file=sys.stderr,
             flush=True,
         )
-        return None, None, None
+        return None, None, None, None
     try:
         docs_b = [t.encode("utf-8") for t in sub]
         glens = model.profile.spec.gram_lengths
         labels = rs.score(docs_b, glens)
-        best, reps, t_total = 0.0, 0, 0.0
-        while (t_total < 0.5 or reps < 3) and reps < 10:
-            t0 = time.perf_counter()
-            rs.score(docs_b, glens)
-            dt = time.perf_counter() - t0
-            t_total += dt
-            reps += 1
-            best = max(best, len(docs_b) / dt)
-        return best, labels, len(keys)
+
+        def best_of(n_threads: int) -> float:
+            best, reps, t_total = 0.0, 0, 0.0
+            while (t_total < 0.5 or reps < 3) and reps < 10:
+                t0 = time.perf_counter()
+                rs.score(docs_b, glens, n_threads=n_threads)
+                dt = time.perf_counter() - t0
+                t_total += dt
+                reps += 1
+                best = max(best, len(docs_b) / dt)
+            return best
+
+        best = best_of(1)
+        best_mt = best_of(usable_cpus())
+        return best, best_mt, labels, len(keys)
     finally:
         rs.close()
 
@@ -693,15 +721,26 @@ def run_config(num: int, deadline: float | None = None) -> dict:
         # passes so the host is idle. For exact configs the C++ map is the
         # model's own gram map, so its labels must agree with the per-row
         # Python baseline exactly (same map, same accumulation order, both
-        # in double) — reported as cpp_agreement.
-        cpp_dps, cpp_labels, cpp_map_grams = (
-            time_cpp_baseline(model, cfg, sub) if sub else (None, None, None)
+        # in double) — reported as cpp_agreement and ENFORCED below: a
+        # semantics drift in refscorer.cpp would silently skew the headline
+        # vs_cpp denominator.
+        cpp_dps, cpp_mt_dps, cpp_labels, cpp_map_grams = (
+            time_cpp_baseline(model, cfg, sub)
+            if sub
+            else (None, None, None, None)
         )
         cpp_agree = None
         if cpp_labels is not None and base_pred:
             cpp_agree = float(np.mean(
                 [a == b for a, b in zip(base_pred, cpp_labels.tolist())]
             ))
+            if cpp_agree < 1.0 and model.profile.spec.mode == "exact":
+                raise SystemExit(
+                    f"C++ baseline disagreement on {cfg['label']}: "
+                    f"{cpp_agree:.4f} — refscorer.cpp has drifted from the "
+                    "per-row reference semantics; the vs_cpp denominator "
+                    "would be wrong, refusing to report perf"
+                )
         compute_dps = measure_compute_only(model, eval_docs)
         wire_mbps = measure_wire_mbps()
         result = {
@@ -756,6 +795,10 @@ def run_config(num: int, deadline: float | None = None) -> dict:
             result["cpp_map_grams"] = cpp_map_grams
             if cpp_agree is not None:
                 result["cpp_agreement"] = round(cpp_agree, 4)
+        if cpp_mt_dps:
+            result["vs_cpp_mt"] = round(device_dps / cpp_mt_dps, 2)
+            result["baseline_cpp_mt_docs_per_s"] = round(cpp_mt_dps, 1)
+            result["cpp_threads"] = usable_cpus()
         if cfg.get("streaming"):
             result["note"] = "rows/sec through run_stream incl. sink"
         return result
@@ -798,7 +841,7 @@ def main():
             summary[num] = {
                 k: result[k]
                 for k in (
-                    "value", "vs_baseline", "vs_numpy", "vs_cpp",
+                    "value", "vs_baseline", "vs_numpy", "vs_cpp", "vs_cpp_mt",
                     "argmax_parity", "accuracy", "shortdoc_accuracy",
                     "confusable_accuracy", "mixed_dominant_accuracy",
                     "hashed_vs_exact_agreement",

@@ -1044,7 +1044,7 @@ class BatchRunner:
             # the prefetch: results are assembled via process_allgather in
             # _fetch, and a host copy of non-addressable shards can't start.
             multiproc = self.mesh is not None and jax.process_count() > 1
-            for _, s, _ in pending if not multiproc else ():
+            for _, s, _ in (pending if not multiproc else ()):
                 arrays = (s,) if not want_labels else (s[0], s[1])
                 for a in arrays:
                     if a is None:

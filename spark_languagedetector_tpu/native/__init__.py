@@ -304,6 +304,10 @@ class RefScorer:
         """int32 [N] first-max-wins argmax labels. ``n_threads=1`` is the
         per-row baseline measurement; more threads model multi-core
         executors (the map is read-only and shared)."""
+        if not self._handle:
+            # After close() the C handle is gone; ref_score would
+            # dereference NULL and segfault rather than raise.
+            raise RuntimeError("RefScorer is closed")
         n = len(byte_docs)
         out = np.empty(n, dtype=np.int32)
         if n == 0:

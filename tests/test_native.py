@@ -109,6 +109,14 @@ def test_refscorer_multithreaded_matches_single():
         rs.close()
 
 
+def test_refscorer_score_after_close_raises():
+    rs = native.RefScorer([b"ab"], np.ones((1, 2)))
+    rs.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        rs.score([b"abab"], [2])
+    rs.close()  # idempotent
+
+
 def test_bench_cpp_key_vecs_hashed_reconstruction():
     """bench._cpp_key_vecs reconstructs a string-keyed map for hashed
     profiles from the training corpus: every harvested gram's bucket id is

@@ -14,13 +14,44 @@ strictly before the last estimator transform the training data during fit
 (the last estimator's model and any stages after it are collected into the
 ``PipelineModel`` without running on the training table); ``copy``
 deep-copies the stage list.
+
+Fitted pipelines persist (``PipelineModel.write().save(path)`` / ``load``),
+mirroring the Spark ML pipeline persistence the reference inherits for free
+(the same MLWritable machinery as its model — LanguageDetectorModel.scala:22-25):
+a ``metadata/`` JSON names the stages in order and each stage saves under
+``stages/<idx>_<uid>/`` — MLWritable stages (the detector model) through
+their own writer, params-only transformers (the preprocessors) as a
+metadata-only directory.
 """
 
 from __future__ import annotations
 
+import json
+import shutil
+import time
+from pathlib import Path
 from typing import Sequence
 
 from ..utils.identifiable import Identifiable
+
+_PIPELINE_MODEL_CLASS = "spark_languagedetector_tpu.api.pipeline.PipelineModel"
+# Stage classes are resolved by import at load time; restrict to this
+# package so pipeline metadata can't be used to import arbitrary modules
+# (the analog of Spark's DefaultParamsReader class check).
+_STAGE_CLASS_PREFIX = "spark_languagedetector_tpu."
+
+
+def _write_metadata(stage_dir: Path, payload: dict) -> None:
+    """``<dir>/metadata/part-00000`` single-line JSON, Spark-style."""
+    meta_dir = stage_dir / "metadata"
+    meta_dir.mkdir(parents=True)
+    (meta_dir / "part-00000").write_text(json.dumps(payload) + "\n")
+
+
+def _read_metadata(stage_dir: Path) -> dict:
+    return json.loads(
+        (stage_dir / "metadata" / "part-00000").read_text().splitlines()[0]
+    )
 
 
 class Pipeline(Identifiable):
@@ -85,3 +116,129 @@ class PipelineModel(Identifiable):
         return PipelineModel(
             [_copy.deepcopy(s) for s in self.stages], uid=self.uid
         )
+
+    # -- persistence -----------------------------------------------------------
+    def write(self) -> "_PipelineModelWriter":
+        return _PipelineModelWriter(self)
+
+    def save(self, path: str) -> None:
+        """Overwrite semantics (like the detector model's ``save``); use
+        ``write().save(path)`` for the fail-if-exists contract."""
+        self.write().overwrite().save(path)
+
+    @staticmethod
+    def load(path: str) -> "PipelineModel":
+        import os
+
+        root = Path(path)
+        meta = _read_metadata(root)
+        if meta.get("class") != _PIPELINE_MODEL_CLASS:
+            raise ValueError(
+                f"metadata class mismatch: expected {_PIPELINE_MODEL_CLASS}, "
+                f"got {meta.get('class')}"
+            )
+        stages = []
+        for info in meta["stages"]:
+            cls = _import_stage_class(info["class"])
+            # The dir name comes from the metadata file — confine it to a
+            # direct child of stages/ (same trust boundary as the class
+            # check above).
+            dir_name = info["dir"]
+            if os.sep in dir_name or dir_name in ("..", ".") or "/" in dir_name:
+                raise ValueError(
+                    f"refusing stage directory name {dir_name!r}: must be a "
+                    "plain name under stages/"
+                )
+            sdir = root / "stages" / dir_name
+            if info.get("writable"):
+                stage = cls.load(str(sdir))
+            else:
+                smeta = _read_metadata(sdir)
+                stage = cls(uid=smeta["uid"])
+                stage._set_params_from_metadata(smeta.get("paramMap", {}))
+            stages.append(stage)
+        return PipelineModel(stages, uid=meta["uid"])
+
+
+def _import_stage_class(name: str):
+    if not name.startswith(_STAGE_CLASS_PREFIX):
+        raise ValueError(
+            f"refusing to import pipeline stage class {name!r}: not part of "
+            f"{_STAGE_CLASS_PREFIX.rstrip('.')}"
+        )
+    import importlib
+
+    module_name, _, cls_name = name.rpartition(".")
+    return getattr(importlib.import_module(module_name), cls_name)
+
+
+class _PipelineModelWriter:
+    """``pipeline_model.write().save(path)`` — MLWritable shape, delegating
+    to each stage's own writer where one exists."""
+
+    def __init__(self, model: PipelineModel):
+        self._model = model
+        self._overwrite = False
+
+    def overwrite(self) -> "_PipelineModelWriter":
+        self._overwrite = True
+        return self
+
+    def save(self, path: str) -> None:
+        import os
+
+        root = Path(path)
+        if root.exists() and not self._overwrite:
+            raise FileExistsError(f"{root} already exists")
+        # Build the whole tree under a temp sibling, then swap it in: a
+        # mid-save failure (disk full, a stage writer raising) must never
+        # destroy an existing good save.
+        tmp = root.parent / f".{root.name}.tmp.{os.getpid()}"
+        if tmp.exists():
+            shutil.rmtree(tmp)
+        tmp.mkdir(parents=True)
+        try:
+            stage_info = []
+            for i, stage in enumerate(self._model.stages):
+                cls = type(stage)
+                cls_name = f"{cls.__module__}.{cls.__qualname__}"
+                writable = hasattr(stage, "write")
+                dir_name = f"{i:02d}_{stage.uid}"
+                sdir = tmp / "stages" / dir_name
+                if writable:
+                    sdir.parent.mkdir(parents=True, exist_ok=True)
+                    stage.write().save(str(sdir))
+                else:
+                    if not hasattr(stage, "param_metadata"):
+                        raise TypeError(
+                            f"pipeline stage {stage!r} has neither write() "
+                            "nor params — cannot persist it"
+                        )
+                    _write_metadata(
+                        sdir,
+                        {
+                            "class": cls_name,
+                            "uid": stage.uid,
+                            "timestamp": int(time.time() * 1000),
+                            "paramMap": stage.param_metadata(),
+                        },
+                    )
+                stage_info.append(
+                    {"class": cls_name, "uid": stage.uid, "dir": dir_name,
+                     "writable": writable}
+                )
+            _write_metadata(
+                tmp,
+                {
+                    "class": _PIPELINE_MODEL_CLASS,
+                    "uid": self._model.uid,
+                    "timestamp": int(time.time() * 1000),
+                    "stages": stage_info,
+                },
+            )
+            if root.exists():  # re-checked: the swap is last and quick
+                shutil.rmtree(root)
+            os.replace(tmp, root)
+        finally:
+            if tmp.exists():
+                shutil.rmtree(tmp)

@@ -92,6 +92,65 @@ def test_stage_after_estimator_not_applied_during_fit():
     assert list(out.column("detected")) == ["de"]
 
 
+def test_pipeline_model_persistence_roundtrip(tmp_path):
+    """Fitted-pipeline persistence: write().save + load round-trips the whole
+    preprocessor + model chain — stage order, stage params (incl. explicit
+    sets), and the detector model's profile — and the loaded pipeline
+    produces identical transforms. The reference gets this for free from
+    Spark ML's pipeline persistence (the same MLWritable machinery as its
+    model, LanguageDetectorModel.scala:22-25)."""
+    model = _pipeline().fit(Table(ROWS))
+    model.stages[-1].set("outputCol", "detected")
+    path = str(tmp_path / "pipe")
+    model.write().save(path)
+
+    # fail-if-exists contract without overwrite()
+    with pytest.raises(FileExistsError):
+        model.write().save(path)
+    model.write().overwrite().save(path)  # and overwrite succeeds
+
+    loaded = PipelineModel.load(path)
+    assert loaded.uid == model.uid
+    assert [type(s).__name__ for s in loaded.stages] == [
+        "LowerCasePreprocessor", "SpecialCharPreprocessor",
+        "LanguageDetectorModel",
+    ]
+    assert [s.uid for s in loaded.stages] == [s.uid for s in model.stages]
+    # Explicitly-set params survive (outputCol on the detector stage, the
+    # in-place column choice on the preprocessors).
+    assert loaded.stages[-1].get("outputCol") == "detected"
+    assert loaded.stages[0].get_output_col() == "fulltext"
+    # Identical profile and identical end-to-end transform.
+    assert (
+        loaded.stages[-1].gram_probabilities.keys()
+        == model.stages[-1].gram_probabilities.keys()
+    )
+    table = Table(
+        {"lang": ["de", "en"],
+         "fulltext": ["Dies ist (ein) deutscher Text", "This is {very} nice"]}
+    )
+    assert list(loaded.transform(table).column("detected")) == list(
+        model.transform(table).column("detected")
+    )
+
+
+def test_pipeline_model_load_rejects_foreign_class(tmp_path):
+    """Stage classes resolve by import at load time; anything outside this
+    package is refused (the DefaultParamsReader class-check analog)."""
+    model = _pipeline().fit(Table(ROWS))
+    path = str(tmp_path / "pipe")
+    model.save(path)
+    import json as _json
+    from pathlib import Path as _Path
+
+    meta_file = _Path(path) / "metadata" / "part-00000"
+    meta = _json.loads(meta_file.read_text())
+    meta["stages"][0]["class"] = "os.path.join"
+    meta_file.write_text(_json.dumps(meta) + "\n")
+    with pytest.raises(ValueError, match="refusing to import"):
+        PipelineModel.load(path)
+
+
 def test_transformer_before_estimator_only_applies_to_prefix():
     """A transformer before the last estimator transforms the training data;
     the estimator itself is last and its model must not run during fit."""

@@ -37,6 +37,7 @@ from ..ops.encoding import (
     bucket_length,
     chunk_document,
     pad_batch,
+    truncate_utf8,
     unpack_ragged,
 )
 from ..ops.vocab import VocabSpec
@@ -222,6 +223,13 @@ class BatchRunner:
     # in a multi-process mesh every process must enqueue collective programs
     # in the same order, and concurrent workers would make that order racy.
     dispatch_workers: int | None = None
+    # Score only the first N bytes of each document (UTF-8-boundary-safe;
+    # ops.encoding.truncate_utf8). None ⇒ score everything. Language
+    # identity saturates within a few hundred bytes, so a ~256B cap cuts
+    # the h2d wire bytes ~len/cap× on long-doc corpora at near-zero
+    # accuracy cost — the wire is the binding wall for short-gram configs
+    # (docs/PERFORMANCE.md §1).
+    max_score_bytes: int | None = None
     metrics: Metrics = field(default_factory=Metrics)
 
     def __post_init__(self):
@@ -838,6 +846,10 @@ class BatchRunner:
         return self._execute(byte_docs, want_labels=True)
 
     def _execute(self, byte_docs: Sequence[bytes], *, want_labels: bool):
+        if self.max_score_bytes:
+            byte_docs = [
+                truncate_utf8(d, self.max_score_bytes) for d in byte_docs
+            ]
         N = len(byte_docs)
         L = self.weights.shape[1]
         if want_labels:

@@ -317,6 +317,17 @@ class LanguageDetectorModel(HasInputCol, HasOutputCol):
         "micro-batch rows per device dispatch; None ⇒ auto per strategy",
         lambda v: v is None or _positive_int(v),
     )
+    max_score_bytes = Param(
+        "maxScoreBytes",
+        "score only the first N bytes of each document (UTF-8-boundary-"
+        "safe truncation; fastText-style cap). Language identity saturates "
+        "within a few hundred bytes, so N≈256 preserves accuracy while "
+        "shipping ~len/N× fewer bytes over the host→device wire — the "
+        "binding bottleneck for short-gram configs. None ⇒ score "
+        "everything (reference behavior: the reference always scores the "
+        "full document, LanguageDetectorModel.scala:139-152)",
+        lambda v: v is None or _positive_int(v),
+    )
 
     def __init__(self, profile: GramProfile, uid: str | None = None):
         super().__init__(uid, uid_prefix="LanguageDetectorModel")
@@ -327,6 +338,7 @@ class LanguageDetectorModel(HasInputCol, HasOutputCol):
             predictEncoding=UTF8,
             backend=BACKEND_AUTO,
             batchSize=None,
+            maxScoreBytes=None,
         )
         self._runner: BatchRunner | None = None
         # Concurrent transforms (the streaming engine runs >1 transform
@@ -358,6 +370,9 @@ class LanguageDetectorModel(HasInputCol, HasOutputCol):
 
     def set_batch_size(self, value: int):
         return self.set("batchSize", value)
+
+    def set_max_score_bytes(self, value: int | None):
+        return self.set("maxScoreBytes", value)
 
     # -- reference accessors ---------------------------------------------------
     @property
@@ -461,6 +476,7 @@ class LanguageDetectorModel(HasInputCol, HasOutputCol):
                         None if mesh is not None else resolve_device(backend)
                     ),
                     mesh=mesh,
+                    max_score_bytes=self.get("maxScoreBytes"),
                 )
             return self._runner
 

@@ -189,6 +189,25 @@ def unpack_ragged(flat, offs, lengths, pad_to: int):
     return flat[idx].reshape(lengths.shape[0], pad_to)
 
 
+def truncate_utf8(doc: bytes, cap: int) -> bytes:
+    """First ``cap`` bytes of a document, never splitting a UTF-8 character:
+    if byte ``cap`` is a continuation byte, the cut backs up to the char
+    boundary (at most 3 bytes). Non-UTF-8 input falls back to the hard cap
+    when backtracking would consume the whole prefix.
+
+    This is the ``maxScoreBytes`` primitive (fastText-style scoring cap):
+    language identity saturates within a few hundred bytes, so scoring only
+    a prefix preserves accuracy while shipping ~len/cap× fewer bytes to the
+    device — the wire, not the MXU, bounds short-gram configs
+    (docs/PERFORMANCE.md §1)."""
+    if cap <= 0 or len(doc) <= cap:
+        return doc
+    k = cap
+    while k > 0 and (doc[k] & 0xC0) == 0x80:
+        k -= 1
+    return doc[:k] if k > 0 else doc[:cap]
+
+
 def chunk_document(
     doc: bytes, chunk_size: int, overlap: int
 ) -> list[bytes]:

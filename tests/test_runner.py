@@ -149,3 +149,64 @@ def test_predict_ids_mesh(eight_devices):
     np.testing.assert_array_equal(
         meshed.predict_ids(docs), np.argmax(single.score(docs), axis=1)
     )
+
+
+def test_max_score_bytes_truncation():
+    """maxScoreBytes: scoring a capped runner equals scoring pre-truncated
+    docs on an uncapped one; docs at or under the cap are untouched; the
+    cap never splits a UTF-8 character (ops.encoding.truncate_utf8)."""
+    from spark_languagedetector_tpu.ops.encoding import truncate_utf8
+
+    profile = GramProfile.from_gram_map(GRAM_MAP, LANGS, (2, 3))
+    weights, lut = profile.device_arrays()
+
+    def runner(cap=None):
+        return BatchRunner(
+            weights=weights, lut=lut, spec=profile.spec, batch_size=4,
+            length_buckets=(16, 64), max_score_bytes=cap,
+        )
+
+    texts = ["ab" * 40, "zz", "abc", "", "bc" * 3, "ab" * 7 + "é" * 10]
+    docs = texts_to_bytes(texts)
+    capped = runner(cap=15).score(docs)
+    manual = runner().score([truncate_utf8(d, 15) for d in docs])
+    np.testing.assert_array_equal(capped, manual)
+    # under-cap docs identical to uncapped scoring
+    uncapped = runner().score(docs)
+    for i, d in enumerate(docs):
+        if len(d) <= 15:
+            np.testing.assert_array_equal(capped[i], uncapped[i])
+
+    # boundary safety: é is 2 bytes; a cut landing mid-char backs up
+    b = "é" * 10
+    enc = b.encode("utf-8")  # 20 bytes
+    assert truncate_utf8(enc, 5) == ("é" * 2).encode()  # 5 -> 4 bytes
+    assert truncate_utf8(enc, 4) == ("é" * 2).encode()
+    assert truncate_utf8(b"abc", 2) == b"ab"
+    assert truncate_utf8(b"abc", 3) == b"abc"
+    assert truncate_utf8(b"\x80\x80\x80", 2) == b"\x80\x80"  # pathological
+
+
+def test_model_max_score_bytes_param():
+    """Model-level maxScoreBytes: capped transform equals transforming the
+    truncated texts, and the param round-trips through persistence."""
+    import tempfile
+
+    from spark_languagedetector_tpu import LanguageDetectorModel, Table
+
+    model = LanguageDetectorModel.from_gram_map(GRAM_MAP, (2, 3), LANGS)
+    texts = ["ab" * 50, "zz" * 3, "abc"]
+    plain = list(model.transform(Table({"fulltext": texts})).column("lang"))
+    model.set_max_score_bytes(8)
+    capped = list(model.transform(Table({"fulltext": texts})).column("lang"))
+    ref = LanguageDetectorModel.from_gram_map(GRAM_MAP, (2, 3), LANGS)
+    want = list(
+        ref.transform(Table({"fulltext": [t[:8] for t in texts]})).column("lang")
+    )
+    assert capped == want
+    assert plain[1:] == capped[1:]  # short docs unaffected
+
+    with tempfile.TemporaryDirectory() as d:
+        model.save(d + "/m")
+        loaded = LanguageDetectorModel.load(d + "/m")
+        assert loaded.get("maxScoreBytes") == 8

@@ -15,13 +15,15 @@ strictly before the last estimator transform the training data during fit
 ``PipelineModel`` without running on the training table); ``copy``
 deep-copies the stage list.
 
-Fitted pipelines persist (``PipelineModel.write().save(path)`` / ``load``),
+Pipelines persist — both fitted (``PipelineModel.write().save(path)`` /
+``load``) and unfitted (``Pipeline.write().save(path)`` / ``load``) —
 mirroring the Spark ML pipeline persistence the reference inherits for free
 (the same MLWritable machinery as its model — LanguageDetectorModel.scala:22-25):
 a ``metadata/`` JSON names the stages in order and each stage saves under
 ``stages/<idx>_<uid>/`` — MLWritable stages (the detector model) through
-their own writer, params-only transformers (the preprocessors) as a
-metadata-only directory.
+their own writer, params-only stages (the preprocessors, and the estimator,
+whose every hyper-parameter is a Param by design) as a metadata-only
+directory.
 """
 
 from __future__ import annotations
@@ -34,6 +36,7 @@ from typing import Sequence
 
 from ..utils.identifiable import Identifiable
 
+_PIPELINE_CLASS = "spark_languagedetector_tpu.api.pipeline.Pipeline"
 _PIPELINE_MODEL_CLASS = "spark_languagedetector_tpu.api.pipeline.PipelineModel"
 # Stage classes are resolved by import at load time; restrict to this
 # package so pipeline metadata can't be used to import arbitrary modules
@@ -96,6 +99,18 @@ class Pipeline(Identifiable):
 
         return Pipeline([_copy.deepcopy(s) for s in self.stages], uid=self.uid)
 
+    # -- persistence (unfitted pipeline, Spark Pipeline.write parity) ----------
+    def write(self) -> "_PipelineModelWriter":
+        return _PipelineModelWriter(self, class_name=_PIPELINE_CLASS)
+
+    def save(self, path: str) -> None:
+        self.write().overwrite().save(path)
+
+    @staticmethod
+    def load(path: str) -> "Pipeline":
+        meta, stages = _load_pipeline_dir(Path(path), _PIPELINE_CLASS)
+        return Pipeline(stages, uid=meta["uid"])
+
 
 class PipelineModel(Identifiable):
     """Transformer chaining the fitted stages of a :class:`Pipeline`."""
@@ -128,36 +143,47 @@ class PipelineModel(Identifiable):
 
     @staticmethod
     def load(path: str) -> "PipelineModel":
-        import os
-
-        root = Path(path)
-        meta = _read_metadata(root)
-        if meta.get("class") != _PIPELINE_MODEL_CLASS:
-            raise ValueError(
-                f"metadata class mismatch: expected {_PIPELINE_MODEL_CLASS}, "
-                f"got {meta.get('class')}"
-            )
-        stages = []
-        for info in meta["stages"]:
-            cls = _import_stage_class(info["class"])
-            # The dir name comes from the metadata file — confine it to a
-            # direct child of stages/ (same trust boundary as the class
-            # check above).
-            dir_name = info["dir"]
-            if os.sep in dir_name or dir_name in ("..", ".") or "/" in dir_name:
-                raise ValueError(
-                    f"refusing stage directory name {dir_name!r}: must be a "
-                    "plain name under stages/"
-                )
-            sdir = root / "stages" / dir_name
-            if info.get("writable"):
-                stage = cls.load(str(sdir))
-            else:
-                smeta = _read_metadata(sdir)
-                stage = cls(uid=smeta["uid"])
-                stage._set_params_from_metadata(smeta.get("paramMap", {}))
-            stages.append(stage)
+        meta, stages = _load_pipeline_dir(Path(path), _PIPELINE_MODEL_CLASS)
         return PipelineModel(stages, uid=meta["uid"])
+
+
+def _load_pipeline_dir(root: Path, expected_class: str):
+    """(metadata, reconstructed stages) for a saved pipeline directory."""
+    import os
+
+    meta = _read_metadata(root)
+    if meta.get("class") != expected_class:
+        raise ValueError(
+            f"metadata class mismatch: expected {expected_class}, "
+            f"got {meta.get('class')}"
+        )
+    stages = []
+    for info in meta["stages"]:
+        cls = _import_stage_class(info["class"])
+        # The dir name comes from the metadata file — confine it to a
+        # direct child of stages/ (same trust boundary as the class
+        # check above).
+        dir_name = info["dir"]
+        if os.sep in dir_name or dir_name in ("..", ".") or "/" in dir_name:
+            raise ValueError(
+                f"refusing stage directory name {dir_name!r}: must be a "
+                "plain name under stages/"
+            )
+        sdir = root / "stages" / dir_name
+        if info.get("writable"):
+            stage = cls.load(str(sdir))
+        else:
+            smeta = _read_metadata(sdir)
+            pmeta = smeta.get("paramMap", {})
+            if hasattr(cls, "_from_param_metadata"):
+                # Stages whose constructor takes required arguments (the
+                # estimator) rebuild themselves from their params.
+                stage = cls._from_param_metadata(smeta["uid"], pmeta)
+            else:
+                stage = cls(uid=smeta["uid"])
+                stage._set_params_from_metadata(pmeta)
+        stages.append(stage)
+    return meta, stages
 
 
 def _import_stage_class(name: str):
@@ -173,11 +199,13 @@ def _import_stage_class(name: str):
 
 
 class _PipelineModelWriter:
-    """``pipeline_model.write().save(path)`` — MLWritable shape, delegating
-    to each stage's own writer where one exists."""
+    """``pipeline.write().save(path)`` — MLWritable shape, delegating to
+    each stage's own writer where one exists (serves both ``Pipeline`` and
+    ``PipelineModel``; the metadata class name tells the loaders apart)."""
 
-    def __init__(self, model: PipelineModel):
+    def __init__(self, model, class_name: str = _PIPELINE_MODEL_CLASS):
         self._model = model
+        self._class_name = class_name
         self._overwrite = False
 
     def overwrite(self) -> "_PipelineModelWriter":
@@ -230,7 +258,7 @@ class _PipelineModelWriter:
             _write_metadata(
                 tmp,
                 {
-                    "class": _PIPELINE_MODEL_CLASS,
+                    "class": self._class_name,
                     "uid": self._model.uid,
                     "timestamp": int(time.time() * 1000),
                     "stages": stage_info,

@@ -140,6 +140,24 @@ class LanguageDetector(_DetectorParams):
         self.set("gramLengths", [int(n) for n in gram_lengths])
         self.set("languageProfileSize", int(language_profile_size))
 
+    @classmethod
+    def _from_param_metadata(cls, uid: str, metadata: dict) -> "LanguageDetector":
+        """Rebuild an estimator from persisted params (pipeline persistence:
+        every hyper-parameter is a Param here — SURVEY.md §5.6 — so the
+        constructor arguments come back out of the metadata)."""
+        flat = {
+            **metadata.get("defaultParams", {}),
+            **metadata.get("params", {}),
+        }
+        det = cls(
+            flat["supportedLanguages"],
+            flat["gramLengths"],
+            flat["languageProfileSize"],
+            uid=uid,
+        )
+        det._set_params_from_metadata(metadata)
+        return det
+
     # -- convenience setters (Spark ML style) ---------------------------------
     def set_save_grams_to(self, path: str | None):
         return self.set("saveGrams", path)

@@ -134,6 +134,34 @@ def test_pipeline_model_persistence_roundtrip(tmp_path):
     )
 
 
+def test_unfitted_pipeline_persistence_roundtrip(tmp_path):
+    """Spark Pipeline.write parity: an UNFITTED pipeline (preprocessors +
+    estimator) round-trips — the estimator rebuilds from its params
+    (constructor args are Params here) — and fitting the loaded pipeline
+    gives the same transforms as fitting the original."""
+    pipe = _pipeline()
+    pipe.stages[-1].set("weightMode", "counts")  # explicit set must survive
+    path = str(tmp_path / "unfitted")
+    pipe.write().save(path)
+    loaded = Pipeline.load(path)
+    assert loaded.uid == pipe.uid
+    assert [s.uid for s in loaded.stages] == [s.uid for s in pipe.stages]
+    det = loaded.stages[-1]
+    assert det.get("supportedLanguages") == LANGS
+    assert det.get("gramLengths") == [2, 3]
+    assert det.get("languageProfileSize") == 50
+    assert det.get("weightMode") == "counts"
+
+    m1, m2 = pipe.fit(Table(ROWS)), loaded.fit(Table(ROWS))
+    for m in (m1, m2):
+        m.stages[-1].set("outputCol", "detected")
+    probe = Table({"lang": ["de", "en"],
+                   "fulltext": ["Noch ein deutscher Text", "One more text"]})
+    assert list(m1.transform(probe).column("detected")) == list(
+        m2.transform(probe).column("detected")
+    )
+
+
 def test_pipeline_model_load_rejects_foreign_class(tmp_path):
     """Stage classes resolve by import at load time; anything outside this
     package is refused (the DefaultParamsReader class-check analog)."""

@@ -49,11 +49,11 @@ Environment knobs:
     BENCH_CONFIGS        comma list, default "2,3,4,5,1" (1 last = headline)
     BENCH_DOCS           override eval-doc count for every config
     BENCH_BASELINE_DOCS  override baseline/parity-doc count for every config
-    BENCH_SOFT_BUDGET_S  soft wall-clock budget (default 900): once spent,
+    BENCH_SOFT_BUDGET_S  soft wall-clock budget (default 1500): once spent,
                          intermediate configs are skipped (noted on stderr)
                          so the final/headline config always runs; the
-                         additive legs (accuracy legs, hashed-vs-exact)
-                         skip first, when under ~2-4 min remain
+                         additive legs (accuracy legs, hashed-vs-exact,
+                         fit bench) skip first, when under ~2-4 min remain
     SLD_TPU_TESTS        "1" => also run the real-TPU parity suite
                          (tests/test_tpu_hw.py) after the headline config,
                          reporting to stderr (stdout stays parseable)
@@ -1060,6 +1060,10 @@ def run_config(num: int, deadline: float | None = None) -> dict:
             result["compute_docs_per_s"] = round(compute_dps, 1)
         if not cfg.get("streaming"):
             result["strategy"] = model._get_runner().strategy
+
+        def budget_left(need_s: float) -> bool:
+            return deadline is None or time.perf_counter() + need_s < deadline
+
         if cap:
             result["max_score_bytes"] = cap
             result["accuracy_fulllen"] = round(accuracy_fulllen, 4)
@@ -1068,8 +1072,29 @@ def run_config(num: int, deadline: float | None = None) -> dict:
             )
             if compute_fulllen:
                 result["compute_docs_per_s_fulllen"] = round(compute_fulllen, 1)
-        def budget_left(need_s: float) -> bool:
-            return deadline is None or time.perf_counter() + need_s < deadline
+            # The cap's real cost case: code-switched docs, where the prefix
+            # can be dominated by the minority language (clean docs show
+            # zero delta down to 128B; mixed docs lose ~4pts at 256B —
+            # measured round 5, the reason the default cap is conservative).
+            # Scored here while the model is still capped; the uncapped leg
+            # below provides the comparison, reported as cap_mixed_delta.
+            pairs = [
+                p for p in _CONFUSABLE_PAIRS if p[0] in langs and p[1] in langs
+            ]
+            # Additive leg: skips with the others when the budget is tight
+            # (a new bucket shape can cost a 20-40s remote compile, and its
+            # only consumer is the uncapped legs' delta below).
+            if pairs and budget_left(180):
+                from spark_languagedetector_tpu import Table as _T
+
+                a, b = pairs[0]
+                mixed = make_mixed_corpus(
+                    a, b, 300, mean_len=400, frac_a=0.7, seed=11
+                )
+                out = model.transform(_T({"fulltext": mixed}))
+                result["mixed_dominant_accuracy_capped"] = round(float(np.mean(
+                    [v == a for v in out.column(model.get_output_col())]
+                )), 4)
 
         # Additive legs (new shapes compile ~20-40s each through a remote-
         # compile tunnel): only when the soft budget has room, so a driver
@@ -1082,6 +1107,14 @@ def run_config(num: int, deadline: float | None = None) -> dict:
             model.set("maxScoreBytes", None)
         if budget_left(120):
             result.update(accuracy_legs(model, cfg, langs, ref_scorer=scorer))
+            if "mixed_dominant_accuracy_capped" in result and (
+                "mixed_dominant_accuracy" in result
+            ):
+                result["cap_mixed_delta"] = round(
+                    result["mixed_dominant_accuracy_capped"]
+                    - result["mixed_dominant_accuracy"],
+                    4,
+                )
         else:
             result["accuracy_legs"] = "skipped (soft budget)"
         if num == 5:
@@ -1139,7 +1172,11 @@ def main():
     # enforces a timeout, the headline config (last in the list) must still
     # run — so once the budget is spent, intermediate configs are skipped
     # (noted on stderr) and the run jumps straight to the final config.
-    budget_s = float(os.environ.get("BENCH_SOFT_BUDGET_S", "900"))
+    # Default sized to the full five-config run with the round-5 additive
+    # legs (fit benches, hard-corpus legs): ~20-25 min through the tunnel.
+    # Round 4's driver tolerated a ~25-minute capture; the summary line
+    # still prints before the hw suite so a harder cut cannot lose it.
+    budget_s = float(os.environ.get("BENCH_SOFT_BUDGET_S", "1500"))
     t_start = time.perf_counter()
     deadline = t_start + budget_s
     failures = 0
@@ -1171,7 +1208,7 @@ def main():
                     "fit_docs_per_s_host", "fit_docs_per_s_device",
                     "fit_device_mismatch", "max_score_bytes",
                     "accuracy_fulllen", "cap_accuracy_delta",
-                    "compute_docs_per_s_fulllen",
+                    "cap_mixed_delta", "compute_docs_per_s_fulllen",
                     "batch_latency_p50_s", "batch_latency_p95_s",
                     "compute_docs_per_s", "wire_mbps",
                 )

@@ -1252,7 +1252,10 @@ def main():
         sys.exit(1)
 
 
-def run_tpu_hw_tests(remaining_budget_s: float = 300.0):
+def run_tpu_hw_tests(
+    remaining_budget_s: float = 300.0,
+    test_path: str = "tests/test_tpu_hw.py",
+):
     """Opt-in real-hardware Mosaic parity suite, after the headline config.
 
     Runs with SLD_TPU_TESTS=1 so the opt-in tests in tests/test_tpu_hw.py
@@ -1299,7 +1302,7 @@ def run_tpu_hw_tests(remaining_budget_s: float = 300.0):
     # finishes, not when the pipe buffer fills.
     proc = subprocess.Popen(
         [
-            sys.executable, "-u", "-m", "pytest", "tests/test_tpu_hw.py",
+            sys.executable, "-u", "-m", "pytest", test_path,
             "-v", "--tb=line", "-p", "no:cacheprovider",
         ],
         cwd=here,
@@ -1308,8 +1311,16 @@ def run_tpu_hw_tests(remaining_budget_s: float = 300.0):
         stderr=subprocess.DEVNULL,
         text=True,
     )
+    # Match on the target's file basename: pytest prints nodeids relative
+    # to its rootdir (possibly with ../ segments), so the path as passed
+    # may not appear. A ::selector target matches on its file component; a
+    # directory target falls back to the generic "<file>.py::name STATUS"
+    # shape.
+    file_part = test_path.split("::", 1)[0].rstrip("/")
+    base = os.path.basename(file_part)
+    name_prefix = re.escape(base) if base.endswith(".py") else r"[\w./-]*\.py"
     verdict_re = re.compile(
-        r"^tests/test_tpu_hw\.py::(\S+)\s+(PASSED|FAILED|ERROR|SKIPPED)"
+        name_prefix + r"::(\S+)\s+(PASSED|FAILED|ERROR|SKIPPED)"
     )
     collected_re = re.compile(r"collecting.*\scollected\s+(\d+)\s+item|^collected\s+(\d+)\s+item")
     results: dict[str, str] = {}
@@ -1321,7 +1332,7 @@ def run_tpu_hw_tests(remaining_budget_s: float = 300.0):
             m = collected_re.search(line)
             if m:
                 n_collected[0] = int(m.group(1) or m.group(2))
-            m = verdict_re.match(line.strip())
+            m = verdict_re.search(line.strip())
             if m:
                 name, status = m.group(1), m.group(2).lower()
                 now = time.perf_counter()

@@ -1,0 +1,103 @@
+"""The bench's incremental on-chip-suite runner (bench.run_tpu_hw_tests).
+
+Exercised against fake pytest files so its contract — one streamed JSON
+verdict per finished test, a {passed: k, of: n} summary, partial results
+on budget expiry, and loud suite errors — is pinned without needing the
+chip. Round 4's defect (an all-or-nothing subprocess timeout voiding the
+whole suite's results) is the regression these guard against.
+"""
+
+import json
+import sys
+
+import pytest
+
+import bench
+
+
+def _run(capsys, monkeypatch, path, budget=60.0, timeout=None):
+    monkeypatch.setenv("SLD_TPU_TESTS", "1")
+    if timeout is not None:
+        monkeypatch.setenv("SLD_TPU_TESTS_TIMEOUT_S", str(timeout))
+    else:
+        monkeypatch.delenv("SLD_TPU_TESTS_TIMEOUT_S", raising=False)
+    bench.run_tpu_hw_tests(budget, test_path=str(path))
+    err = capsys.readouterr().err
+    lines = [json.loads(l) for l in err.splitlines() if l.startswith("{")]
+    per_test = [l for l in lines if "tpu_hw_test" in l]
+    summaries = [l for l in lines if "tpu_hw_tests" in l]
+    assert len(summaries) == 1, err
+    return per_test, summaries[0]["tpu_hw_tests"]
+
+
+def test_streams_per_test_verdicts_and_summary(tmp_path, capsys, monkeypatch):
+    f = tmp_path / "test_fakehw.py"
+    f.write_text(
+        "import pytest\n"
+        "def test_ok(): pass\n"
+        "def test_also_ok(): pass\n"
+        "def test_bad(): assert False\n"
+        "@pytest.mark.skip\n"
+        "def test_skipped(): pass\n"
+    )
+    per_test, summary = _run(capsys, monkeypatch, f)
+    assert {t["tpu_hw_test"]: t["status"] for t in per_test} == {
+        "test_ok": "passed", "test_also_ok": "passed",
+        "test_bad": "failed", "test_skipped": "skipped",
+    }
+    assert summary["passed"] == 2
+    assert summary["of"] == 4
+    assert summary["failed"] == 1
+    assert summary["skipped"] == 1
+    assert summary.get("pytest_exit") == 1  # pytest exits 1 on failures
+    assert "budget_expired" not in summary
+
+
+def test_budget_expiry_keeps_finished_results(tmp_path, capsys, monkeypatch):
+    f = tmp_path / "test_fakehw.py"
+    f.write_text(
+        "import time\n"
+        "def test_fast(): pass\n"
+        "def test_slow(): time.sleep(300)\n"
+    )
+    # Generous pre-kill window: pytest-in-pytest startup on a loaded
+    # single-CPU host can take several seconds before the fast verdict.
+    per_test, summary = _run(capsys, monkeypatch, f, timeout=25)
+    # The fast test's verdict survived the kill; the slow one never reports.
+    assert {t["tpu_hw_test"] for t in per_test} == {"test_fast"}
+    assert summary["passed"] == 1
+    assert summary["of"] == 2
+    assert summary["budget_expired"] is True
+
+
+def test_collection_error_is_loud(tmp_path, capsys, monkeypatch):
+    f = tmp_path / "test_fakehw.py"
+    f.write_text("import nonexistent_module_xyz\n")
+    per_test, summary = _run(capsys, monkeypatch, f)
+    assert per_test == []
+    assert summary["passed"] == 0
+    assert summary["suite_error"] is True
+    assert summary["pytest_exit"] != 0
+
+
+def test_directory_and_selector_targets(tmp_path, capsys, monkeypatch):
+    """The runner's verdict matching survives non-file targets: a directory
+    (generic <file>.py::name matching) and a ::selector node id."""
+    f = tmp_path / "test_fakehw.py"
+    f.write_text("def test_one(): pass\ndef test_two(): pass\n")
+    per_test, summary = _run(capsys, monkeypatch, tmp_path)  # directory
+    assert summary["passed"] == 2 and summary["of"] == 2
+    assert {t["tpu_hw_test"] for t in per_test} == {"test_one", "test_two"}
+    per_test, summary = _run(capsys, monkeypatch, f"{f}::test_two")
+    assert summary["passed"] == 1 and summary["of"] == 1
+    assert per_test[0]["tpu_hw_test"] == "test_two"
+
+
+def test_opt_out_and_low_budget_skip(tmp_path, capsys, monkeypatch):
+    monkeypatch.setenv("SLD_TPU_TESTS", "0")
+    bench.run_tpu_hw_tests(9999.0, test_path=str(tmp_path / "none.py"))
+    assert capsys.readouterr().err == ""
+    # Opportunistic mode with <60s of budget left: don't start the suite.
+    monkeypatch.setenv("SLD_TPU_TESTS", "")
+    bench.run_tpu_hw_tests(10.0, test_path=str(tmp_path / "none.py"))
+    assert capsys.readouterr().err == ""

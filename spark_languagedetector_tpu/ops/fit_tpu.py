@@ -101,22 +101,158 @@ def top_k_rows(weights: jnp.ndarray, *, k: int) -> jnp.ndarray:
        slots are filled from it. The plateau always has at least that many
        members, so every filled slot is valid.
 
-    Integer keys (not f32 -id) keep id order exact beyond 2^24.
+    Integer keys (not f32 -id) keep id order exact beyond 2^24. One
+    implementation site: this is :func:`_block_top_k` over the whole table
+    as a single block (ids == row indices at offset 0).
     """
-    wT = weights.T  # [L, V]
-    V = wT.shape[1]
-    vals, idx = jax.lax.top_k(wT, k)
-    w_star = vals[:, k - 1 : k]  # [L, 1] boundary value
-    n_above = (wT > w_star).sum(axis=1, keepdims=True)  # [L, 1], <= k
-    iota = jnp.arange(V, dtype=jnp.int32)[None, :]
-    plateau_key = jnp.where(
-        wT == w_star, -iota, jnp.iinfo(jnp.int32).min
-    )
-    _, pidx = jax.lax.top_k(plateau_key, k)  # plateau ids, ascending
+    return _block_top_k(weights.T, k, 0)[1]
+
+
+# Beyond this many dense-table elements the single-shot lax.top_k sort
+# (whose TPU lowering materializes [L, V] f32 + s32 sort temps) would OOM a
+# 16GB chip — config 3's exact-trigram table is 16.8M × 50 = 842M elements,
+# ~13GB of sort temp. The blocked two-stage top-k below bounds the sort to
+# [L, block] per step.
+TOPK_SORT_BUDGET_ELEMS = 256 * 1024 * 1024
+
+
+@partial(jax.jit, static_argnames=("weight_mode",))
+def masked_candidate_weights(counts: jnp.ndarray, *, weight_mode: str):
+    """Masked weights [V, L] in ONE compiled program, so the unmasked
+    weight table never materializes as a separate buffer — at config-3
+    scale each [V, L] f32 buffer is 3.4GB and the fit's HBM peak is what
+    decides whether the single-chip device fit fits at all. Non-occurred
+    rows mask to -inf (not candidates)."""
+    w = weights_from_counts(counts, weight_mode=weight_mode)
+    occurred = counts.sum(axis=1) > 0
+    return jnp.where(occurred[:, None], w, -jnp.inf)
+
+
+def _block_top_k(blk: jnp.ndarray, k: int, id_offset: int):
+    """(values [L, k], global ids [L, k]) for one vocab block under the
+    (value desc, id asc) total order — the same boundary-plateau re-ranking
+    as :func:`top_k_rows`, with ids offset into the global vocab axis."""
+    L, W = blk.shape
+    vals, idx = jax.lax.top_k(blk, k)
+    w_star = vals[:, k - 1 : k]
+    n_above = (blk > w_star).sum(axis=1, keepdims=True)
+    iota = jnp.arange(W, dtype=jnp.int32)[None, :]
+    plateau_key = jnp.where(blk == w_star, -iota, jnp.iinfo(jnp.int32).min)
+    _, pidx = jax.lax.top_k(plateau_key, k)
     j = jnp.arange(k, dtype=jnp.int32)[None, :]
     shifted = jnp.clip(j - n_above, 0, k - 1)
-    plateau_rows = jnp.take_along_axis(pidx, shifted, axis=1)
-    return jnp.where(j < n_above, idx, plateau_rows).astype(jnp.int32)
+    rows = jnp.where(
+        j < n_above, idx, jnp.take_along_axis(pidx, shifted, axis=1)
+    )
+    gvals = jnp.take_along_axis(blk, rows, axis=1)
+    # id_offset: python int (unrolled path) or traced int32 (scan path).
+    return gvals, rows.astype(jnp.int32) + id_offset
+
+
+def _final_candidates_top_k(cv: jnp.ndarray, ci: jnp.ndarray, k: int):
+    """Top-k over (value, real-id) candidate pairs under the (value desc,
+    id asc) total order: value top-k for the strictly-above entries, then
+    the boundary plateau re-ranked by the candidates' REAL ids (not
+    positions) so global tie order holds."""
+    fvals, fidx = jax.lax.top_k(cv, k)
+    w_star = fvals[:, k - 1 : k]
+    n_above = (cv > w_star).sum(axis=1, keepdims=True)
+    plateau_key = jnp.where(cv == w_star, -ci, jnp.iinfo(jnp.int32).min)
+    pvals, _ = jax.lax.top_k(plateau_key, k)
+    plateau_ids = -pvals  # ascending real ids; slots past the plateau are
+    j = jnp.arange(k, dtype=jnp.int32)[None, :]  # never selected (see proof
+    shifted = jnp.clip(j - n_above, 0, k - 1)  # in top_k_rows_blocked)
+    above_ids = jnp.take_along_axis(ci, fidx, axis=1)
+    return jnp.where(
+        j < n_above,
+        above_ids,
+        jnp.take_along_axis(plateau_ids, shifted, axis=1),
+    ).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("k", "block"))
+def top_k_rows_blocked(
+    weights: jnp.ndarray, *, k: int, block: int = 1 << 21
+) -> jnp.ndarray:
+    """Two-stage top-k over the vocab axis: per-block winners under the
+    (value desc, id asc) total order, then a final selection over the
+    gathered candidates — SURVEY §7.4's "sharded top_k + merge",
+    single-device edition (the mesh path gets the same effect from GSPMD's
+    local-top-k + cross-shard merge over the vocab sharding).
+
+    Exact: any member of the global top-k has at most k-1 entries ahead of
+    it under the total order, hence at most k-1 within its own block, so it
+    survives its block's top-k; and a block's plateau contribution (lowest
+    ids first) always covers the global selection's need from that block
+    (needed-from-block ≤ k − that block's above-boundary count). Bounds the
+    lax.top_k sort temp to [L, block] instead of [L, V].
+    """
+    wT = weights.T  # [L, V]
+    L, V = wT.shape
+    if V <= block:
+        return top_k_rows(weights, k=k)
+    cand_v, cand_i = [], []
+    for s in range(0, V, block):
+        blk = wT[:, s : s + block]
+        bk = min(k, blk.shape[1])
+        bv, bi = _block_top_k(blk, bk, s)
+        cand_v.append(bv)
+        cand_i.append(bi)
+    cv = jnp.concatenate(cand_v, axis=1)
+    ci = jnp.concatenate(cand_i, axis=1)
+    return _final_candidates_top_k(cv, ci, k)
+
+
+@partial(jax.jit, static_argnames=("weight_mode", "k", "block"))
+def finalize_topk_blocked(
+    counts: jnp.ndarray,
+    *,
+    weight_mode: str,
+    k: int,
+    block: int = 1 << 21,
+) -> jnp.ndarray:
+    """Count table → top-k rows WITHOUT ever materializing the full [V, L]
+    weight table: a lax.scan walks the vocab axis block by block, computing
+    each block's weights + candidate mask from its COUNT slice and keeping
+    only its top-k (value desc, id asc) candidates; a final selection over
+    the gathered candidates finishes the job.
+
+    This is the memory shape that actually fits config-3 scale on one chip
+    (V=16.8M × L=50): the naive finalize needs counts (3.4GB) + weights
+    (3.4GB) + masked (3.4GB) + a [L, V] transpose (3.4GB) + an [L, V] sort
+    temp (~13GB); this program's working set is counts + one
+    [block, L]/[L, block] slice pipeline (~5GB — even a padded copy of
+    counts proved too much for the compile-time budget, so the tail block
+    slides BACK to stay in bounds instead of padding). Lanes a tail block
+    re-reads from its predecessor are masked to -inf and their ids set to
+    the sentinel V; -inf candidates can only surface for a language with
+    fewer than k real candidates, and the caller filters both by id < V
+    and by occurrence, so the final profile is unaffected.
+    """
+    V, L = counts.shape
+    block = min(block, V)
+    nb = -(-V // block)
+
+    def body(carry, i):
+        start = jnp.minimum(i * block, V - block)
+        cblk = jax.lax.dynamic_slice_in_dim(counts, start, block, 0)
+        w = weights_from_counts(cblk, weight_mode=weight_mode)
+        occ = cblk.sum(axis=1) > 0
+        # Tail block: lanes before i*block were already owned by the
+        # previous block — exclude them from this block's candidates.
+        lane = jnp.arange(block, dtype=jnp.int32)
+        owned = (start + lane) >= i * block
+        blk = jnp.where((occ & owned)[:, None], w, -jnp.inf).T  # [L, block]
+        bv, bi = _block_top_k(blk, min(k, block), start)
+        bi = jnp.where(bi >= i * block, bi, jnp.int32(V))  # unowned → V
+        return carry, (bv, bi)
+
+    _, (vals, ids) = jax.lax.scan(
+        body, None, jnp.arange(nb, dtype=jnp.int32)
+    )
+    cv = vals.transpose(1, 0, 2).reshape(L, -1)
+    ci = ids.transpose(1, 0, 2).reshape(L, -1)
+    return _final_candidates_top_k(cv, ci, k)
 
 
 def fit_dense_step(
@@ -227,20 +363,28 @@ def fit_profile_device(
         if e_ids.size:
             counts = counts.at[e_ids, e_langs].add(e_counts)
 
-    dense_w = weights_from_counts(counts, weight_mode=weight_mode)
-    occurred = counts.sum(axis=1) > 0
     # Non-occurred rows are not candidates (the reference's table only holds
-    # grams seen in training); mask them below any real weight for top-k.
-    masked = jnp.where(occurred[:, None], dense_w, -jnp.inf)
+    # grams seen in training); they mask below any real weight for top-k.
     k = min(profile_size, V)
-    top = top_k_rows(masked, k=k)  # [L, k]; ties → lowest id (re-ranked)
+    if V * num_langs > TOPK_SORT_BUDGET_ELEMS:
+        # Big tables (config-3 scale): the scanned finalize never
+        # materializes the [V, L] weight table and bounds the top-k sort
+        # per vocab block; ties → lowest id either way.
+        top = finalize_topk_blocked(counts, weight_mode=weight_mode, k=k)
+    else:
+        masked = masked_candidate_weights(counts, weight_mode=weight_mode)
+        top = top_k_rows(masked, k=k)  # [L, k]; ties → lowest id (re-ranked)
 
     top_np = np.unique(np.asarray(top).reshape(-1))
-    occurred_np = np.asarray(occurred[jnp.asarray(top_np)])
-    rows = top_np[occurred_np]  # dense row index == gram id
+    top_np = top_np[top_np < V]  # blocked-path pad rows carry ids >= V
     # Recompute winner weights on host in float64 from the exact integer
-    # counts (see docstring) instead of fetching the device's float32 table.
-    counts_rows = np.asarray(counts[jnp.asarray(rows)], dtype=np.int64)
+    # counts (see docstring) instead of fetching the device's float32 table;
+    # the same gathered rows decide occurrence (non-occurred candidates
+    # surface only for languages with fewer than k real grams).
+    counts_sel = np.asarray(counts[jnp.asarray(top_np)], dtype=np.int64)
+    occurred_np = counts_sel.sum(axis=1) > 0
+    rows = top_np[occurred_np]  # dense row index == gram id
+    counts_rows = counts_sel[occurred_np]
     if weight_mode == "parity":
         present = counts_rows > 0
         nlangs = present.sum(axis=1, keepdims=True)

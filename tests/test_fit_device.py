@@ -149,3 +149,97 @@ def test_top_k_rows_breaks_ties_by_lowest_id():
         rows = np.asarray(top_k_rows(jnp.asarray(w), k=k))[lang]
         want = set(strong.tolist()) | set(sorted(plateau.tolist())[: k - 5])
         assert set(rows.tolist()) == want, f"lang {lang}"
+
+
+def test_top_k_rows_blocked_matches_single_stage():
+    """The two-stage (vocab-blocked) top-k selects the exact same row SET
+    as the single-stage one under the (value desc, id asc) order — the
+    OOM-proof path config-3-scale device fits take. Adversarial cases:
+    plateaus crossing both block and selection boundaries, plateaus
+    spanning multiple blocks, languages with fewer candidates than k,
+    block sizes that do and do not divide V."""
+    import jax.numpy as jnp
+
+    from spark_languagedetector_tpu.ops.fit_tpu import (
+        top_k_rows,
+        top_k_rows_blocked,
+    )
+
+    rng = np.random.default_rng(11)
+    for trial in range(4):
+        V = int(rng.integers(300, 1200))
+        L = int(rng.integers(1, 5))
+        k = int(rng.integers(2, 40))
+        # Few distinct values => giant tie plateaus (the parity weight
+        # formula's regime), randomly placed across the whole vocab axis.
+        levels = np.asarray([-np.inf, 0.0, 0.3, 0.6931472, 1.1], np.float32)
+        w = levels[rng.integers(0, len(levels), size=(V, L))].astype(np.float32)
+        # One language nearly empty (fewer real candidates than k).
+        w[:, 0] = -np.inf
+        w[rng.choice(V, size=max(k // 2, 1), replace=False), 0] = 0.5
+        single = np.asarray(top_k_rows(jnp.asarray(w), k=k))
+        for block in (64, V // 2 + 1):  # non-dividing and dividing widths
+            blocked = np.asarray(
+                top_k_rows_blocked(jnp.asarray(w), k=k, block=block)
+            )
+            for lang in range(L):
+                assert set(blocked[lang]) == set(single[lang]), (
+                    trial, block, lang,
+                )
+
+
+def test_fit_profile_device_blocked_topk_route_matches():
+    """Force the blocked-top-k route through a tiny budget and check the
+    full device fit still bit-matches the host fit."""
+    from spark_languagedetector_tpu.ops import fit_tpu
+
+    docs = [t.encode() for t in [
+        "abcabc", "bcabca", "cabcab", "aabbcc", "abccba", "cbaabc",
+    ]]
+    langs = np.asarray([0, 0, 1, 1, 2, 2])
+    spec = VocabSpec(EXACT, (1, 2))
+    want_ids, want_w = fit_profile_numpy(docs, langs, 3, spec, 5, PARITY)
+    budget = fit_tpu.TOPK_SORT_BUDGET_ELEMS
+    fit_tpu.TOPK_SORT_BUDGET_ELEMS = 1  # force the blocked route
+    try:
+        got_ids, got_w = fit_profile_device(docs, langs, 3, spec, 5, PARITY)
+    finally:
+        fit_tpu.TOPK_SORT_BUDGET_ELEMS = budget
+    np.testing.assert_array_equal(got_ids, want_ids)
+    np.testing.assert_allclose(got_w, want_w, rtol=1e-6, atol=1e-7)
+
+
+def test_finalize_topk_blocked_matches_naive():
+    """The scanned count→top-k finalize (no full weight table) selects the
+    same row set as masked weights + single-stage top-k, across weight
+    modes, with zero-count pad rows never surfacing for languages that
+    have >= k real candidates (and filtered by the id < V rule otherwise)."""
+    import jax.numpy as jnp
+
+    from spark_languagedetector_tpu.ops.fit_tpu import (
+        finalize_topk_blocked,
+        masked_candidate_weights,
+        top_k_rows,
+    )
+
+    rng = np.random.default_rng(23)
+    for mode in (PARITY, COUNTS):
+        V, L, k = 700, 4, 25
+        counts = rng.integers(0, 4, size=(V, L)).astype(np.int32)
+        counts[rng.random((V, L)) < 0.7] = 0  # sparse, big tie plateaus
+        counts[:, 2] = 0  # a language with zero occurrences anywhere
+        masked = masked_candidate_weights(
+            jnp.asarray(counts), weight_mode=mode
+        )
+        single = np.asarray(top_k_rows(masked, k=k))
+        for block in (96, 350, 701):
+            got = np.asarray(finalize_topk_blocked(
+                jnp.asarray(counts), weight_mode=mode, k=k, block=block
+            ))
+            for lang in range(L):
+                g = {i for i in got[lang] if i < V}
+                s = set(single[lang].tolist())
+                # Compare the REAL-candidate selections: below-k languages
+                # pad arbitrarily in both paths, so intersect with occurred.
+                occ = {i for i in range(V) if counts[i].sum() > 0}
+                assert g & occ == s & occ, (mode, block, lang)

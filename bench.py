@@ -606,6 +606,85 @@ def hashed_vs_exact(model, cfg, langs):
         return {}
 
 
+# ------------------------------------------------------------- telemetry ----
+def telemetry_setup():
+    """Wire this config's telemetry: jax.monitoring hooks + a JSONL sink.
+
+    Returns the JSONL path the run records into. LANGDETECT_METRICS_SINK
+    wins when it already declares a jsonl sink (attached at package
+    import); otherwise a per-process file under the system tmpdir is
+    attached (per-config calls reuse the first sink). Aggregates are reset
+    per call so each config's breakdown block is self-contained — span
+    percentiles from config N must not dilute config N+1's (the JSONL
+    event log still carries everything, sinks survive the reset).
+    """
+    import tempfile
+
+    from spark_languagedetector_tpu.telemetry import REGISTRY, install_jax_hooks
+    from spark_languagedetector_tpu.telemetry.export import JsonlSink
+
+    install_jax_hooks()
+    REGISTRY.reset()
+    for sink in REGISTRY.sinks:
+        if getattr(sink, "kind", "") == "jsonl":
+            return sink.path
+    path = os.path.join(
+        tempfile.gettempdir(), f"bench_telemetry_{os.getpid()}.jsonl"
+    )
+    REGISTRY.add_sink(JsonlSink(path))
+    return path
+
+
+def telemetry_block(jsonl_path: str) -> dict:
+    """The per-config telemetry block for the BENCH_* artifact: the JSONL
+    path plus the per-stage breakdown since this config's telemetry_setup
+    (count / total seconds / percentiles per span path), so rounds get
+    stage-level trajectories instead of one end-to-end docs/s. Device
+    gauges are sampled and the snapshot sinks flushed on the way out."""
+    from spark_languagedetector_tpu.telemetry import (
+        REGISTRY,
+        sample_device_gauges,
+    )
+
+    sample_device_gauges()
+    REGISTRY.flush()
+    return {"jsonl": jsonl_path, "stages": REGISTRY.stage_summary()}
+
+
+def smoke_telemetry(jsonl_path: str | None = None) -> dict:
+    """Tiny CPU-safe fit + score pass with telemetry on: the bench's smoke
+    path. Writes span events to ``jsonl_path`` (default: a fresh tmp file),
+    returns the result dict with the telemetry block. Used by
+    ``python bench.py --smoke-telemetry`` and the tier-1 suite — it must
+    stay fast (~seconds) and accelerator-free.
+    """
+    import tempfile
+
+    from spark_languagedetector_tpu import LanguageDetector, Table
+    from spark_languagedetector_tpu.telemetry import REGISTRY, install_jax_hooks
+    from spark_languagedetector_tpu.telemetry.export import JsonlSink
+
+    install_jax_hooks()
+    REGISTRY.reset()
+    path = jsonl_path or os.path.join(
+        tempfile.gettempdir(), f"telemetry_smoke_{os.getpid()}.jsonl"
+    )
+    sink = JsonlSink(path)
+    REGISTRY.add_sink(sink)
+    try:
+        langs = language_names(3)
+        docs, labels = make_corpus(langs, 60, mean_len=200, seed=3)
+        det = LanguageDetector(langs, [1, 2], 200)
+        model = det.fit(Table({"lang": labels, "fulltext": docs}))
+        out = model.transform(Table({"fulltext": docs}))
+        assert len(out.column(model.get_output_col())) == len(docs)
+        return {"smoke": True, "docs": len(docs), **{
+            "telemetry": telemetry_block(path)
+        }}
+    finally:
+        REGISTRY.remove_sink(sink)
+
+
 # ------------------------------------------------------------ per config ----
 CONFIGS = {
     # cap: ship maxScoreBytes=256 on the headline config — language identity
@@ -848,6 +927,7 @@ def run_config(num: int, deadline: float | None = None) -> dict:
     from concurrent.futures import ThreadPoolExecutor
 
     cfg = CONFIGS[num]
+    telemetry_jsonl = telemetry_setup()
     model = fit_model(cfg)
     langs = language_names(cfg["n_langs"])
     n_docs = int(os.environ.get("BENCH_DOCS", cfg["docs"]))
@@ -1155,6 +1235,10 @@ def run_config(num: int, deadline: float | None = None) -> dict:
                 result["batch_latency_p50_s"] = round(lat_p50, 3)
                 result["batch_latency_p95_s"] = round(lat_p95, 3)
                 result["latency_batch_rows"] = 8192
+        # Stage-level breakdown (cumulative through this config) + the JSONL
+        # event-log path, so the BENCH artifact localizes a regression to a
+        # stage instead of reporting one opaque end-to-end number.
+        result["telemetry"] = telemetry_block(telemetry_jsonl)
         return result
     finally:
         # The model cache outlives this config: never leak the cap.
@@ -1167,6 +1251,21 @@ def run_config(num: int, deadline: float | None = None) -> dict:
 
 
 def main():
+    if "--smoke-telemetry" in sys.argv[1:]:
+        # Telemetry smoke path: tiny CPU fit+score with the JSONL sink on,
+        # one JSON line out (the report CLI renders the stage tree from the
+        # printed jsonl path). Seconds, not minutes — safe anywhere.
+        args = [a for a in sys.argv[1:] if a != "--smoke-telemetry"]
+        flags = [a for a in args if a.startswith("-")]
+        if flags or len(args) > 1:
+            print(
+                f"usage: python bench.py --smoke-telemetry [out.jsonl] "
+                f"(got {args})",
+                file=sys.stderr,
+            )
+            sys.exit(2)
+        print(json.dumps(smoke_telemetry(args[0] if args else None)), flush=True)
+        return
     order = [
         int(c)
         for c in os.environ.get("BENCH_CONFIGS", "2,3,4,5,1").split(",")
@@ -1244,6 +1343,10 @@ def main():
     final.setdefault("metric", "langid docs/sec/chip (headline, config "
                      f"{order[-1] if order else '?'})")
     final.setdefault("unit", "docs/sec")
+    try:
+        final["telemetry_jsonl"] = telemetry_setup()
+    except Exception:
+        pass
     final["summary"] = summary
     print(json.dumps(final, separators=(",", ":")), flush=True)
     remaining = budget_s - (time.perf_counter() - t_start)

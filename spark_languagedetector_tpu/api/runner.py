@@ -21,6 +21,7 @@ synchronization is the final result fetch.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Sequence
 
@@ -41,6 +42,7 @@ from ..ops.encoding import (
     unpack_ragged,
 )
 from ..ops.vocab import VocabSpec
+from ..telemetry import REGISTRY, span
 from ..utils.logging import get_logger, log_event
 from ..utils.metrics import Metrics
 
@@ -945,6 +947,18 @@ class BatchRunner:
                 limit_np = None
             else:
                 limit_np = np.asarray(batch_limits, dtype=np.int32)
+            # Fill/waste distributions: what fraction of the transferred
+            # buffer is real bytes — observed per path below, against the
+            # capacity that actually rides the wire (padded [B, S], or the
+            # ragged path's bucketed flat buffer). Mesh pad rows count as
+            # waste like any other padding.
+            real_bytes = sum(len(d) for d in batch_docs)
+
+            def observe_fill(capacity: int) -> None:
+                fill = real_bytes / capacity if capacity else 1.0
+                REGISTRY.observe("score/batch_fill_ratio", fill)
+                REGISTRY.observe("score/padding_waste", 1.0 - fill)
+
             if (
                 self.ragged_transfer
                 and self.mesh is None
@@ -969,15 +983,31 @@ class BatchRunner:
                     round_chunks(total, step) * RAGGED_CHUNK
                     < len(batch_docs) * pad_to
                 ):
-                    flat_np, offs_np, lengths_np = native.pack_ragged(
-                        batch_docs, pad_to, flat_step=step
-                    )
-                    return self._dispatch_ragged(
-                        flat_np, offs_np, lengths_np, limit_np, placement,
-                        pad_to,
-                    )
-            batch_np, lengths_np = self._pack(batch_docs, pad_to)
-            return self._dispatch_batch(batch_np, lengths_np, limit_np, placement)
+                    observe_fill(round_chunks(total, step) * RAGGED_CHUNK)
+                    with span("score/pack", parent=score_span,
+                              rows=len(batch_docs), pad_to=pad_to):
+                        flat_np, offs_np, lengths_np = native.pack_ragged(
+                            batch_docs, pad_to, flat_step=step
+                        )
+                    with span("score/dispatch", parent=score_span,
+                              rows=len(batch_docs), pad_to=pad_to) as sp:
+                        scores = self._dispatch_ragged(
+                            flat_np, offs_np, lengths_np, limit_np, placement,
+                            pad_to,
+                        )
+                        sp.fence(scores)
+                    return scores
+            observe_fill(len(batch_docs) * pad_to)
+            with span("score/pack", parent=score_span,
+                      rows=len(batch_docs), pad_to=pad_to):
+                batch_np, lengths_np = self._pack(batch_docs, pad_to)
+            with span("score/dispatch", parent=score_span,
+                      rows=len(batch_docs), pad_to=pad_to) as sp:
+                scores = self._dispatch_batch(
+                    batch_np, lengths_np, limit_np, placement
+                )
+                sp.fence(scores)
+            return scores
 
         doc_idx_arr = np.asarray(doc_idx, dtype=np.int64)
         # Chunked docs (len > max_chunk) need their full score rows fetched
@@ -1015,13 +1045,19 @@ class BatchRunner:
             fetch-failure path, so peak host RSS stays O(workers × batch),
             not O(corpus)."""
             sel, pad_to = item
+            t0 = time.perf_counter()
             try:
                 scores = build_and_dispatch(sel, pad_to)
             except RETRYABLE as e:
                 log_event(_log, "runner.retry", rows=len(sel), error=repr(e))
                 self.metrics.incr("retries")
+                REGISTRY.incr("score/retries")
+                call_retries.append(1)
                 scores = build_and_dispatch(sel, pad_to)
             self.metrics.incr("chunks_scored", len(sel))
+            REGISTRY.observe(
+                "score/batch_latency_s", time.perf_counter() - t0
+            )
             if want_labels:
                 return (sel, project(sel, scores), pad_to)
             return (sel, scores, pad_to)
@@ -1037,7 +1073,12 @@ class BatchRunner:
         if workers is None:
             workers = DISPATCH_WORKERS if self.mesh is None else 1
         workers = max(1, min(workers, len(plan)))
-        with trace(), self.metrics.timer("score_s"):
+        # Per-call retry tally (list append is GIL-atomic, so dispatch
+        # workers need no extra lock); the registry counter is lifetime.
+        call_retries: list[int] = []
+        with trace(), self.metrics.timer("score_s"), span(
+            "score", docs=N, batches=len(plan), strategy=self.strategy
+        ) as score_span:
             if workers > 1:
                 from concurrent.futures import ThreadPoolExecutor
 
@@ -1056,64 +1097,71 @@ class BatchRunner:
             # the prefetch: results are assembled via process_allgather in
             # _fetch, and a host copy of non-addressable shards can't start.
             multiproc = self.mesh is not None and jax.process_count() > 1
-            for _, s, _ in (pending if not multiproc else ()):
-                arrays = (s,) if not want_labels else (s[0], s[1])
-                for a in arrays:
-                    if a is None:
-                        continue
+            with span("score/fetch", batches=len(plan)):
+                for _, s, _ in (pending if not multiproc else ()):
+                    arrays = (s,) if not want_labels else (s[0], s[1])
+                    for a in arrays:
+                        if a is None:
+                            continue
+                        try:
+                            a.copy_to_host_async()
+                        except (AttributeError, *RETRYABLE):
+                            # AttributeError: non-jax array (numpy test
+                            # doubles). Runtime errors: a batch whose
+                            # deferred execution error surfaces here — the
+                            # fetch loop retries it.
+                            pass
+                for sel, s, pad_to in pending:
                     try:
-                        a.copy_to_host_async()
-                    except (AttributeError, *RETRYABLE):
-                        # AttributeError: non-jax array (numpy test doubles).
-                        # Runtime errors: a batch whose deferred execution
-                        # error surfaces here — the fetch loop retries it.
-                        pass
-            for sel, s, pad_to in pending:
-                try:
+                        if want_labels:
+                            am, sub, pos = s
+                            am_host = self._fetch(am)
+                            sub_host = None if sub is None else self._fetch(sub)
+                        else:
+                            host = self._fetch(s)
+                    except RETRYABLE as e:
+                        # A failure surfacing only at fetch time (async
+                        # dispatch defers execution errors here): replay the
+                        # batch once, synchronously. NOT on a multi-process
+                        # mesh: a replay enqueues fresh collectives on this
+                        # process alone, desynchronizing the process-wide
+                        # collective schedule _fetch depends on — propagate
+                        # instead (the caller's whole call is replayable on
+                        # every process together).
+                        if multiproc:
+                            raise
+                        log_event(
+                            _log, "runner.retry_fetch", rows=len(sel),
+                            error=repr(e),
+                        )
+                        self.metrics.incr("retries")
+                        REGISTRY.incr("score/retries")
+                        call_retries.append(1)
+                        scores = build_and_dispatch(sel, pad_to)
+                        if want_labels:
+                            am, sub, pos = project(sel, scores)
+                            am_host = self._fetch(am)
+                            sub_host = None if sub is None else self._fetch(sub)
+                        else:
+                            host = self._fetch(scores)
+                    # Rows beyond len(sel) are mesh pad rows — dropped here.
                     if want_labels:
-                        am, sub, pos = s
-                        am_host = self._fetch(am)
-                        sub_host = None if sub is None else self._fetch(sub)
+                        docs_of = doc_idx_arr[sel]
+                        whole = np.ones(len(sel), dtype=bool)
+                        if pos.size:
+                            whole[pos] = False
+                            rows = [chunk_rank[doc_idx[sel[p]]] for p in pos]
+                            np.add.at(chunk_acc, rows, sub_host)
+                        out[docs_of[whole]] = am_host[: len(sel)][whole]
                     else:
-                        host = self._fetch(s)
-                except RETRYABLE as e:
-                    # A failure surfacing only at fetch time (async dispatch
-                    # defers execution errors here): replay the batch once,
-                    # synchronously. NOT on a multi-process mesh: a replay
-                    # enqueues fresh collectives on this process alone,
-                    # desynchronizing the process-wide collective schedule
-                    # _fetch depends on — propagate instead (the caller's
-                    # whole call is replayable on every process together).
-                    if multiproc:
-                        raise
-                    log_event(
-                        _log, "runner.retry_fetch", rows=len(sel), error=repr(e)
-                    )
-                    self.metrics.incr("retries")
-                    scores = build_and_dispatch(sel, pad_to)
-                    if want_labels:
-                        am, sub, pos = project(sel, scores)
-                        am_host = self._fetch(am)
-                        sub_host = None if sub is None else self._fetch(sub)
-                    else:
-                        host = self._fetch(scores)
-                # Rows beyond len(sel) are mesh pad rows — dropped here.
-                if want_labels:
-                    docs_of = doc_idx_arr[sel]
-                    whole = np.ones(len(sel), dtype=bool)
-                    if pos.size:
-                        whole[pos] = False
-                        rows = [chunk_rank[doc_idx[sel[p]]] for p in pos]
-                        np.add.at(chunk_acc, rows, sub_host)
-                    out[docs_of[whole]] = am_host[: len(sel)][whole]
-                else:
-                    np.add.at(out, doc_idx_arr[sel], host[: len(sel)])
+                        np.add.at(out, doc_idx_arr[sel], host[: len(sel)])
 
         if want_labels and chunk_rank:
             for i, r in chunk_rank.items():
                 out[i] = int(np.argmax(chunk_acc[r]))
 
         self.metrics.incr("docs_scored", N)
+        REGISTRY.observe("score/retries_per_call", len(call_retries))
         log_event(
             _log,
             "runner.score",

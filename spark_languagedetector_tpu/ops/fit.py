@@ -27,6 +27,7 @@ from typing import Sequence
 
 import numpy as np
 
+from ..telemetry import span
 from .encoding import pad_batch
 from .vocab import EXACT, VocabSpec, window_ids_numpy
 
@@ -209,6 +210,11 @@ def fit_profile_numpy(
     weight_mode: str = PARITY,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Full host fit: returns (sorted gram ids [G], weights [G, L] float64)."""
-    gram_counts = extract_gram_counts(byte_docs, lang_indices, num_langs, spec)
-    unique_ids, weights = compute_weights(gram_counts, weight_mode)
-    return select_top_grams(unique_ids, weights, profile_size)
+    with span("fit/count", docs=len(byte_docs), backend="cpu"):
+        gram_counts = extract_gram_counts(
+            byte_docs, lang_indices, num_langs, spec
+        )
+    with span("fit/weights", pairs=len(gram_counts.ids)):
+        unique_ids, weights = compute_weights(gram_counts, weight_mode)
+    with span("fit/topk", k=profile_size):
+        return select_top_grams(unique_ids, weights, profile_size)

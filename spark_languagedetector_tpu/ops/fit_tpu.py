@@ -19,6 +19,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from ..telemetry import span
+from ..telemetry.gauges import note_donation_reuse
 from .vocab import VocabSpec, partial_window_ids, window_ids
 
 
@@ -271,6 +273,17 @@ def fit_dense_step(
     )
 
 
+# Donating accumulation step for the single-device fit loop: the [V, L]
+# accumulator is the fit's dominant buffer (3.4GB at config-3 scale), and
+# the loop never reads the pre-step value again — donating it lets XLA
+# update in place instead of double-buffering. Accelerators only: the CPU
+# backend can't consume donations and would warn per batch. One body, two
+# compilations — the math can never diverge between the two step modes.
+_fit_dense_step_donated = partial(
+    jax.jit, static_argnames=("spec", "num_langs"), donate_argnums=(3,)
+)(fit_dense_step)
+
+
 def fit_profile_device(
     byte_docs,
     lang_indices,
@@ -319,6 +332,7 @@ def fit_profile_device(
     counts = jnp.zeros((V, num_langs), dtype=jnp.int32)
     step = fit_dense_step
     ndata = 1
+    donate = False
     if mesh is not None:
         from ..parallel.mesh import DATA_AXIS, replicated
         from ..parallel.sharded import make_sharded_fit_step
@@ -330,31 +344,44 @@ def fit_profile_device(
         def step(batch, lengths, lang_ids, acc, **_):
             return sharded(batch, lengths, lang_ids, acc)
 
+    elif jax.devices()[0].platform != "cpu":
+        step = _fit_dense_step_donated
+        donate = True
+
     lang_arr = np.asarray(lang_indices, dtype=np.int32)
     order = np.argsort([len(d) for d in byte_docs], kind="stable")
     max_bucket = DEFAULT_LENGTH_BUCKETS[-1]
-    for start in range(0, len(order), batch_rows):
-        sel = order[start : start + batch_rows]
-        docs = [byte_docs[i] for i in sel]
-        langs = lang_arr[sel]
-        if ndata > 1:
-            from ..parallel.mesh import pad_rows_for_mesh
+    with span(
+        "fit/count", docs=len(byte_docs), backend="device", shards=ndata
+    ) as count_span:
+        for start in range(0, len(order), batch_rows):
+            sel = order[start : start + batch_rows]
+            docs = [byte_docs[i] for i in sel]
+            langs = lang_arr[sel]
+            if ndata > 1:
+                from ..parallel.mesh import pad_rows_for_mesh
 
-            docs, langs = pad_rows_for_mesh(docs, ndata, (langs, 0))
-        longest = max((len(d) for d in docs), default=1)
-        if longest <= max_bucket:
-            pad_to = bucket_length(longest, DEFAULT_LENGTH_BUCKETS)
-        else:  # oversized docs: round up (recompiles per distinct width)
-            pad_to = -(-longest // 2048) * 2048
-        batch, lengths = pad_batch(docs, pad_to=pad_to)
-        counts = step(
-            jnp.asarray(batch),
-            jnp.asarray(lengths),
-            jnp.asarray(langs),
-            counts,
-            spec=spec,
-            num_langs=num_langs,
-        )
+                docs, langs = pad_rows_for_mesh(docs, ndata, (langs, 0))
+            longest = max((len(d) for d in docs), default=1)
+            if longest <= max_bucket:
+                pad_to = bucket_length(longest, DEFAULT_LENGTH_BUCKETS)
+            else:  # oversized docs: round up (recompiles per distinct width)
+                pad_to = -(-longest // 2048) * 2048
+            batch, lengths = pad_batch(docs, pad_to=pad_to)
+            prev = counts
+            counts = step(
+                jnp.asarray(batch),
+                jnp.asarray(lengths),
+                jnp.asarray(langs),
+                counts,
+                spec=spec,
+                num_langs=num_langs,
+            )
+            if donate:
+                note_donation_reuse(prev)
+        # Count dispatch is async: fencing (opt-in) bills the span the
+        # device_s through the last batch's completion.
+        count_span.fence(counts)
 
     if extra_counts is not None:
         e_ids, e_langs, e_counts = (
@@ -366,14 +393,16 @@ def fit_profile_device(
     # Non-occurred rows are not candidates (the reference's table only holds
     # grams seen in training); they mask below any real weight for top-k.
     k = min(profile_size, V)
-    if V * num_langs > TOPK_SORT_BUDGET_ELEMS:
-        # Big tables (config-3 scale): the scanned finalize never
-        # materializes the [V, L] weight table and bounds the top-k sort
-        # per vocab block; ties → lowest id either way.
-        top = finalize_topk_blocked(counts, weight_mode=weight_mode, k=k)
-    else:
-        masked = masked_candidate_weights(counts, weight_mode=weight_mode)
-        top = top_k_rows(masked, k=k)  # [L, k]; ties → lowest id (re-ranked)
+    with span("fit/topk", backend="device", k=k, vocab=V) as topk_span:
+        if V * num_langs > TOPK_SORT_BUDGET_ELEMS:
+            # Big tables (config-3 scale): the scanned finalize never
+            # materializes the [V, L] weight table and bounds the top-k sort
+            # per vocab block; ties → lowest id either way.
+            top = finalize_topk_blocked(counts, weight_mode=weight_mode, k=k)
+        else:
+            masked = masked_candidate_weights(counts, weight_mode=weight_mode)
+            top = top_k_rows(masked, k=k)  # ties → lowest id (re-ranked)
+        topk_span.fence(top)
 
     top_np = np.unique(np.asarray(top).reshape(-1))
     top_np = top_np[top_np < V]  # blocked-path pad rows carry ids >= V
@@ -381,18 +410,19 @@ def fit_profile_device(
     # counts (see docstring) instead of fetching the device's float32 table;
     # the same gathered rows decide occurrence (non-occurred candidates
     # surface only for languages with fewer than k real grams).
-    counts_sel = np.asarray(counts[jnp.asarray(top_np)], dtype=np.int64)
-    occurred_np = counts_sel.sum(axis=1) > 0
-    rows = top_np[occurred_np]  # dense row index == gram id
-    counts_rows = counts_sel[occurred_np]
-    if weight_mode == "parity":
-        present = counts_rows > 0
-        nlangs = present.sum(axis=1, keepdims=True)
-        ratio = np.where(present, 1.0 / np.maximum(nlangs, 1), 0.0)
-    else:
-        totals = counts_rows.sum(axis=1, keepdims=True)
-        ratio = counts_rows / np.maximum(totals, 1)
-    weights = np.log1p(ratio.astype(np.float64))
+    with span("fit/collect", winners=int(top_np.size)):
+        counts_sel = np.asarray(counts[jnp.asarray(top_np)], dtype=np.int64)
+        occurred_np = counts_sel.sum(axis=1) > 0
+        rows = top_np[occurred_np]  # dense row index == gram id
+        counts_rows = counts_sel[occurred_np]
+        if weight_mode == "parity":
+            present = counts_rows > 0
+            nlangs = present.sum(axis=1, keepdims=True)
+            ratio = np.where(present, 1.0 / np.maximum(nlangs, 1), 0.0)
+        else:
+            totals = counts_rows.sum(axis=1, keepdims=True)
+            ratio = counts_rows / np.maximum(totals, 1)
+        weights = np.log1p(ratio.astype(np.float64))
     return rows.astype(np.int64), weights
 
 
@@ -468,19 +498,28 @@ def fit_profile_device_split(
         weight_mode, mesh=mesh, extra_counts=extra,
     )
 
-    gc = fit_ops.extract_gram_counts(
-        byte_docs, lang_arr, num_langs, spec,
-        gram_lengths_subset=long_lengths, min_partial_gram_len=4,
-    )
-    ids_high, w_high = fit_ops.compute_weights(gc, weight_mode)
-    ids_high, w_high = fit_ops.select_top_grams(
-        ids_high, w_high, profile_size
-    )
+    # The host long-gram half is often the split fit's dominant cost —
+    # record it under the same stage paths the pure-host fit uses so the
+    # breakdown stays attributable (attrs distinguish the halves).
+    with span(
+        "fit/count", docs=len(byte_docs), backend="host", grams="long"
+    ):
+        gc = fit_ops.extract_gram_counts(
+            byte_docs, lang_arr, num_langs, spec,
+            gram_lengths_subset=long_lengths, min_partial_gram_len=4,
+        )
+    with span("fit/weights", pairs=len(gc.ids), backend="host"):
+        ids_high, w_high = fit_ops.compute_weights(gc, weight_mode)
+    with span("fit/topk", backend="host", k=profile_size):
+        ids_high, w_high = fit_ops.select_top_grams(
+            ids_high, w_high, profile_size
+        )
 
-    all_ids = np.concatenate([np.asarray(ids_low, np.int64), ids_high])
-    all_w = np.concatenate(
-        [np.asarray(w_low, np.float64), np.asarray(w_high, np.float64)]
-    )
-    ids, weights = fit_ops.select_top_grams(all_ids, all_w, profile_size)
-    order = np.argsort(ids)
-    return ids[order], np.ascontiguousarray(weights[order])
+    with span("fit/merge", k=profile_size):
+        all_ids = np.concatenate([np.asarray(ids_low, np.int64), ids_high])
+        all_w = np.concatenate(
+            [np.asarray(w_low, np.float64), np.asarray(w_high, np.float64)]
+        )
+        ids, weights = fit_ops.select_top_grams(all_ids, all_w, profile_size)
+        order = np.argsort(ids)
+        return ids[order], np.ascontiguousarray(weights[order])

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops import fit_tpu
 from ..ops.score import score_batch
 from ..ops.vocab import VocabSpec
+from ..telemetry import span
 from .mesh import DATA_AXIS, VOCAB_AXIS, batch_sharding, replicated, vocab_sharding
 
 
@@ -66,10 +67,22 @@ def make_sharded_scorer(
             batch, lengths, weights, lut, spec=spec, block=block
         )
 
+    ndata = int(mesh.shape[DATA_AXIS])
+
     def wrapper(batch, lengths, weights, lut=None):
         if lut is None:
             lut = jnp.zeros(0, jnp.int32)  # sentinel: dense direct indexing
-        return scorer(batch, lengths, weights, lut)
+        # Dispatch is one GSPMD program over every shard; the span carries
+        # the shard geometry (rows_per_shard × shards) and — under fencing
+        # — the device time through the slowest shard's completion.
+        with span(
+            "shard_score",
+            shards=ndata,
+            rows_per_shard=batch.shape[0] // ndata,
+        ) as sp:
+            out = scorer(batch, lengths, weights, lut)
+            sp.fence(out)
+        return out
 
     return wrapper
 
@@ -105,7 +118,19 @@ def make_sharded_fit_step(
             batch, lengths, lang_ids, counts_acc, spec=spec, num_langs=num_langs
         )
 
-    return fit_step
+    ndata = int(mesh.shape[DATA_AXIS])
+
+    def timed_step(batch, lengths, lang_ids, counts_acc):
+        with span(
+            "shard_step",
+            shards=ndata,
+            rows_per_shard=batch.shape[0] // ndata,
+        ) as sp:
+            out = fit_step(batch, lengths, lang_ids, counts_acc)
+            sp.fence(out)
+        return out
+
+    return timed_step
 
 
 def make_sharded_finalize(
@@ -134,7 +159,18 @@ def make_sharded_finalize(
         top_rows = fit_tpu.top_k_rows(weights, k=k)
         return weights, top_rows
 
-    return finalize
+    nshards = int(mesh.shape[VOCAB_AXIS] if shard_vocab else 1)
+
+    def timed_finalize(counts):
+        # No k passthrough: pjit raises "does not support kwargs when
+        # in_shardings is specified" for any kwarg, static ones included,
+        # so the jitted finalize is only ever callable with its baked-in k.
+        with span("shard_finalize", shards=nshards) as sp:
+            weights, top_rows = finalize(counts)
+            sp.fence(weights, top_rows)
+        return weights, top_rows
+
+    return timed_finalize
 
 
 def training_step(

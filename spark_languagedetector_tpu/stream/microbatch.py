@@ -22,6 +22,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Iterable, Iterator, Sequence
 
 from ..api.table import Table
+from ..telemetry import REGISTRY, span
 from ..utils.logging import get_logger, log_event
 from ..utils.metrics import Metrics
 
@@ -129,14 +130,23 @@ def run_stream(
     it = iter(source)
 
     def transform_once(batch: Table, seq: int) -> Table:
-        try:
-            return model.transform(batch)
-        except Exception:  # transient failure: replay once (stateless)
-            log_event(_log, "stream.retry", batch=seq)
-            # May run on the worker thread concurrently with the caller's
-            # counter writes — Metrics serializes internally.
-            query.metrics.incr("retries")
-            return model.transform(batch)
+        # Runs on a prefetch worker thread when the pipeline is deep: the
+        # explicit parent pins the span under this run's "stream" root (a
+        # fresh thread has no ambient span to inherit), so concurrent
+        # workers all aggregate under stream/transform.
+        with span(
+            "stream/transform", parent=stream_span, batch=seq,
+            rows=batch.num_rows,
+        ):
+            try:
+                return model.transform(batch)
+            except Exception:  # transient failure: replay once (stateless)
+                log_event(_log, "stream.retry", batch=seq)
+                # May run on the worker thread concurrently with the
+                # caller's counter writes — Metrics serializes internally.
+                query.metrics.incr("retries")
+                REGISTRY.incr("stream/retries")
+                return model.transform(batch)
 
     n_workers = workers if workers is not None else min(2, max(prefetch, 1))
     executor = (
@@ -145,60 +155,76 @@ def run_stream(
     in_flight: deque = deque()  # (batch, seq, future-or-None)
     seq = 0
     try:
-        while True:
-            # Check the budget BEFORE pulling: a source like Kafka consumes
-            # (and may auto-commit) records on next(), so an over-pulled
-            # batch would be silently lost.
-            want_more = (
-                max_batches is None
-                or query.batches + len(in_flight) < max_batches
-            )
-            batch = None
-            if want_more:
-                try:
-                    batch = next(it)
-                except StopIteration:
-                    want_more = False
-            if batch is not None:
-                fut = (
-                    None
-                    if executor is None
-                    else executor.submit(transform_once, batch, seq)
+        with span(
+            "stream", prefetch=prefetch, workers=n_workers
+        ) as stream_span:
+            while True:
+                # Check the budget BEFORE pulling: a source like Kafka
+                # consumes (and may auto-commit) records on next(), so an
+                # over-pulled batch would be silently lost.
+                want_more = (
+                    max_batches is None
+                    or query.batches + len(in_flight) < max_batches
                 )
-                in_flight.append((batch, seq, fut))
-                seq += 1
-            if not in_flight:
-                break
-            # Drain when the pipeline is full or the source is done. The
-            # timer covers processing (transform-or-wait + sink) only, never
-            # idle source polling, matching the synchronous loop's
-            # throughput semantics.
-            if len(in_flight) > prefetch or not want_more or batch is None:
-                src, src_seq, fut = in_flight.popleft()
-                t0 = time.perf_counter()
-                with query.metrics.timer("total_s"):
-                    out = (
-                        transform_once(src, src_seq)
-                        if fut is None
-                        else fut.result()
+                batch = None
+                if want_more:
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        want_more = False
+                if batch is not None:
+                    fut = (
+                        None
+                        if executor is None
+                        else executor.submit(transform_once, batch, seq)
                     )
-                    sink(out)
-                dt = time.perf_counter() - t0
-                query.batches += 1
-                query.rows += src.num_rows
-                query.last_batch_rows = src.num_rows
-                query.last_batch_seconds = dt
-                query.metrics.incr("rows", src.num_rows)
-                query.metrics.incr("batches")
-                if on_progress is not None:
-                    on_progress(query)
-                log_event(
-                    _log,
-                    "stream.batch",
-                    n=query.batches,
-                    rows=src.num_rows,
-                    seconds=dt,
-                )
+                    in_flight.append((batch, seq, fut))
+                    seq += 1
+                if not in_flight:
+                    break
+                # Drain when the pipeline is full or the source is done. The
+                # timer covers processing (transform-or-wait + sink) only,
+                # never idle source polling, matching the synchronous loop's
+                # throughput semantics.
+                if len(in_flight) > prefetch or not want_more or batch is None:
+                    REGISTRY.observe("stream/queue_depth", len(in_flight))
+                    REGISTRY.set_gauge("stream/queue_depth", len(in_flight))
+                    src, src_seq, fut = in_flight.popleft()
+                    t0 = time.perf_counter()
+                    with query.metrics.timer("total_s"), span(
+                        "stream/batch", batch=src_seq, rows=src.num_rows
+                    ):
+                        if fut is None:
+                            out = transform_once(src, src_seq)
+                        else:
+                            # Sink-visible stall: how long the drain sat
+                            # waiting on the prefetch worker — the signal
+                            # separating "wire is behind" from "sink is
+                            # behind" when stream throughput drops.
+                            t_wait = time.perf_counter()
+                            out = fut.result()
+                            REGISTRY.observe(
+                                "stream/prefetch_stall_s",
+                                time.perf_counter() - t_wait,
+                            )
+                        with span("sink", rows=src.num_rows):
+                            sink(out)  # nests as stream/batch/sink
+                    dt = time.perf_counter() - t0
+                    query.batches += 1
+                    query.rows += src.num_rows
+                    query.last_batch_rows = src.num_rows
+                    query.last_batch_seconds = dt
+                    query.metrics.incr("rows", src.num_rows)
+                    query.metrics.incr("batches")
+                    if on_progress is not None:
+                        on_progress(query)
+                    log_event(
+                        _log,
+                        "stream.batch",
+                        n=query.batches,
+                        rows=src.num_rows,
+                        seconds=dt,
+                    )
     finally:
         if executor is not None:
             # Don't wait for transforms of batches this run will never sink.

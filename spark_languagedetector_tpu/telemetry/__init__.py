@@ -1,0 +1,75 @@
+"""Telemetry subsystem: spans, histograms, gauges, and exporters.
+
+The reference implementation leans entirely on the Spark UI for visibility
+(SURVEY.md §5.5 — it "has no metrics at all"); the flat counters/timers in
+``utils.metrics`` record *that* time was spent but not *where*. This package
+is the stage-level layer the north star needs (per-stage latency
+distributions are a prerequisite for multi-chip tuning — the pjit/GSPMD
+systems papers treat per-stage profiling and compile-cache accounting as
+table stakes):
+
+  * :func:`span` — nestable, thread-safe context managers producing a tree
+    of wall/device timings keyed by slash paths (``"score/pack"``). A span
+    can register device arrays to fence (``block_until_ready``) at exit so
+    the recorded time covers device completion, not just dispatch.
+  * :class:`Histogram` — deterministic-reservoir distributions exposing
+    p50/p90/p99 (per-batch score latency, batch fill ratio, padding waste,
+    retry counts).
+  * gauges sampled from JAX itself (:mod:`.gauges`) — live-buffer bytes per
+    device, compile-cache hits/misses and compile seconds via
+    ``jax.monitoring`` hooks, donated-buffer reuse.
+  * exporters (:mod:`.export`) — a JSONL event sink (``log_event``-schema
+    compatible) and a Prometheus text-format snapshot writer, both
+    selectable via ``LANGDETECT_METRICS_SINK``.
+  * ``python -m spark_languagedetector_tpu.telemetry.report <jsonl>`` — a
+    stage-tree summary CLI with percentiles (:mod:`.report`).
+
+Everything aggregates into one process-global :data:`REGISTRY`; sinks are
+attached from the environment on first import. Importing this package does
+NOT initialize jax — device-touching helpers import it lazily.
+"""
+
+from __future__ import annotations
+
+from .export import (
+    SINK_ENV,
+    configure_sinks_from_env,
+    render_prometheus,
+    write_prometheus,
+)
+from .gauges import install_jax_hooks, sample_device_gauges
+from .registry import REGISTRY, Histogram, Registry
+from .spans import FENCE_ENV, Span, current_span, span
+
+__all__ = [
+    "FENCE_ENV",
+    "Histogram",
+    "REGISTRY",
+    "Registry",
+    "SINK_ENV",
+    "Span",
+    "configure_sinks_from_env",
+    "current_span",
+    "install_jax_hooks",
+    "render_prometheus",
+    "sample_device_gauges",
+    "span",
+    "write_prometheus",
+]
+
+# Attach exporters declared in the environment once, at import: every
+# instrumented module imports this package, so a process that sets
+# LANGDETECT_METRICS_SINK gets its sinks without any code change. A bad
+# value (typo'd kind, unwritable path) degrades to a loud warning rather
+# than an ImportError — a metrics env var must never take down scoring.
+# Calling configure_sinks_from_env directly still raises.
+try:
+    configure_sinks_from_env(REGISTRY)
+except Exception as _e:
+    import warnings as _warnings
+
+    _warnings.warn(
+        f"{SINK_ENV} ignored — could not attach metric sinks: {_e}",
+        RuntimeWarning,
+        stacklevel=2,
+    )

@@ -1,0 +1,178 @@
+"""Gauges sampled from JAX itself: buffers, compiles, donation reuse.
+
+Three device-side signals the host-side spans cannot see:
+
+  * **live-buffer bytes per device** — every ``jax.live_arrays()`` buffer,
+    attributed to its device(s); the resident-set gauge that localizes an
+    HBM blowup to the stage that allocated it.
+  * **compile-cache accounting** — ``jax.monitoring`` event hooks count
+    compilation-cache hits/misses and sum backend-compile seconds. On a
+    tunneled TPU a single new batch shape costs a 20-40s remote compile
+    (docs/PERFORMANCE.md §5), so an unexpected miss is the first thing to
+    rule out when a bench pass regresses.
+  * **donated-buffer reuse** — the fit loop donates its count accumulator;
+    ``jax.Array.is_deleted()`` on the pre-step reference observes whether
+    XLA actually reused the buffer (donation is best-effort and silently
+    degrades on some backends).
+
+All helpers import jax lazily and degrade to no-ops when an API is absent,
+so the telemetry package stays importable in stripped environments.
+"""
+
+from __future__ import annotations
+
+from .registry import REGISTRY, Registry
+
+_hooks_installed = False
+_hooks_registry: Registry | None = None
+
+# jax.monitoring event names this module accounts (jax/_src/compiler.py and
+# jax/_src/dispatch.py are the emit sites). The duration match must be
+# exact: jax emits three per-compile duration events whose names all
+# contain "compile" (trace, MLIR lowering, backend compile) plus a
+# compile_time_saved event on persistent-cache HITS — a substring match
+# would triple-count and bill time *saved* as time *spent*.
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def install_jax_hooks(registry: Registry | None = None) -> bool:
+    """Register jax.monitoring listeners feeding the registry. Idempotent;
+    returns whether hooks are (now) installed.
+
+    Counters: ``jax/compile_cache_hits``, ``jax/compile_cache_misses``,
+    ``jax/compile_events``. Histogram: ``jax/compile_s`` (per-compile
+    backend seconds). Listener registration is process-global in jax —
+    there is one receiving registry per process (the most recent caller's;
+    the process-global REGISTRY by default), never one per call.
+    """
+    global _hooks_installed, _hooks_registry
+    # Rebind on every call: jax offers no listener deregistration, so the
+    # closures below read the module slot instead of capturing a registry.
+    _hooks_registry = registry if registry is not None else REGISTRY
+    if _hooks_installed:
+        return True
+    try:
+        from jax import monitoring
+    except Exception:
+        return False
+
+    def on_event(event: str, **kwargs) -> None:
+        reg = _hooks_registry
+        if reg is None:
+            return
+        if event == _CACHE_HIT_EVENT:
+            reg.incr("jax/compile_cache_hits")
+        elif event == _CACHE_MISS_EVENT:
+            reg.incr("jax/compile_cache_misses")
+
+    def on_duration(event: str, duration: float, **kwargs) -> None:
+        reg = _hooks_registry
+        if reg is None:
+            return
+        if event == _BACKEND_COMPILE_EVENT:
+            reg.incr("jax/compile_events")
+            reg.observe("jax/compile_s", duration)
+
+    # jax offers no deregistration, so once ANY listener lands the module
+    # must remember it — a retry after a partial failure would register a
+    # duplicate and double-count every cache hit/miss from then on.
+    registered = False
+    try:
+        monitoring.register_event_listener(on_event)
+        registered = True
+        monitoring.register_event_duration_secs_listener(on_duration)
+    except Exception:
+        if not registered:
+            return False
+    _hooks_installed = True
+    return True
+
+
+def _device_label(d) -> str:
+    """Short, label-safe device name (``tpu:0``): the full ``str(device)``
+    on TPU contains commas/parens/spaces, which are hostile to every flat
+    label serialization downstream."""
+    try:
+        return f"{d.platform}:{d.id}"
+    except Exception:
+        return str(d)
+
+
+def sample_device_gauges(registry: Registry | None = None) -> dict:
+    """Sample per-device buffer gauges into the registry; returns them too.
+
+    ``live_buffer_bytes{device=...}`` sums ``jax.live_arrays()`` (a sharded
+    array's bytes split evenly across its devices);
+    ``device_bytes_in_use{device=...}`` comes from the runtime's
+    ``memory_stats()`` where the backend provides it (TPU does, CPU does
+    not). Sampling walks the live-array list — per-batch/per-flush cost,
+    not per-row.
+    """
+    reg = registry if registry is not None else REGISTRY
+    out: dict[str, dict[str, float]] = {}
+    try:
+        import jax
+    except Exception:
+        return out
+
+    live: dict[str, float] = {}
+    try:
+        for arr in jax.live_arrays():
+            try:
+                devices = list(arr.devices())
+                nbytes = float(getattr(arr, "nbytes", 0))
+            except Exception:
+                continue
+            if not devices:
+                continue
+            per_dev = nbytes / len(devices)
+            for d in devices:
+                lbl = _device_label(d)
+                live[lbl] = live.get(lbl, 0.0) + per_dev
+    except Exception:
+        pass
+    for dev, nbytes in live.items():
+        reg.set_gauge("live_buffer_bytes", nbytes, device=dev)
+    if live:
+        out["live_buffer_bytes"] = live
+
+    in_use: dict[str, float] = {}
+    try:
+        for d in jax.local_devices():
+            stats = None
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                pass
+            if stats and "bytes_in_use" in stats:
+                in_use[_device_label(d)] = float(stats["bytes_in_use"])
+    except Exception:
+        pass
+    for dev, nbytes in in_use.items():
+        reg.set_gauge("device_bytes_in_use", nbytes, device=dev)
+    if in_use:
+        out["device_bytes_in_use"] = in_use
+    return out
+
+
+def note_donation_reuse(prev_array, registry: Registry | None = None) -> bool:
+    """Record whether a donated input buffer was actually consumed.
+
+    Call with the pre-step reference after a donating dispatch:
+    ``is_deleted()`` True means XLA took the buffer (reuse happened) —
+    counted as ``jax/donated_reuse``; False means donation silently
+    degraded to a copy — counted as ``jax/donated_copy``. Returns the
+    reuse verdict (False when unobservable).
+    """
+    reg = registry if registry is not None else REGISTRY
+    is_deleted = getattr(prev_array, "is_deleted", None)
+    if is_deleted is None:
+        return False
+    try:
+        reused = bool(is_deleted())
+    except Exception:
+        return False
+    reg.incr("jax/donated_reuse" if reused else "jax/donated_copy")
+    return reused

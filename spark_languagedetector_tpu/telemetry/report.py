@@ -1,0 +1,157 @@
+"""Stage-tree report CLI over a telemetry JSONL capture.
+
+    python -m spark_languagedetector_tpu.telemetry.report <events.jsonl>
+
+Reads the JSONL event stream the ``jsonl`` sink appends, aggregates the
+``telemetry.span`` records by slash path, and renders the stage tree with
+per-stage count, total/mean seconds, and p50/p90/p99 — the artifact that
+turns "fit throughput split across configs" into "the count stage did"
+(BENCH_r05's unanswerable question). Counter/gauge state from the last
+``telemetry.snapshot`` event is appended below the tree.
+
+Pure stdlib + this package's Histogram; never imports jax, so it runs
+anywhere the artifact lands (including the zero-accelerator CI host).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from .registry import Histogram
+
+
+def load_events(path: str) -> list[dict]:
+    """Parse one JSONL file, skipping blank/garbage lines loudly-but-gently
+    (a truncated tail from a killed run must not void the report)."""
+    events: list[dict] = []
+    bad = 0
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                bad += 1
+                continue
+            if isinstance(obj, dict):
+                events.append(obj)
+    if bad:
+        print(f"(skipped {bad} unparseable line(s))", file=sys.stderr)
+    return events
+
+
+def aggregate_spans(events: list[dict]) -> dict[str, Histogram]:
+    """path -> Histogram of wall_s over every telemetry.span record."""
+    stages: dict[str, Histogram] = {}
+    for ev in events:
+        if ev.get("event") != "telemetry.span":
+            continue
+        path = ev.get("path")
+        wall = ev.get("wall_s")
+        if not isinstance(path, str) or not isinstance(wall, (int, float)):
+            continue
+        hist = stages.get(path)
+        if hist is None:
+            hist = stages[path] = Histogram()
+        hist.record(float(wall))
+    return stages
+
+
+def _tree_rows(stages: dict[str, Histogram]):
+    """(indented label, histogram|None) rows in tree order.
+
+    Intermediate path segments that never recorded a span of their own
+    (e.g. only ``score/pack`` events, no bare ``score``) still render as
+    headers so the hierarchy reads correctly.
+    """
+    known = set(stages)
+    all_paths = set()
+    for path in known:
+        parts = path.split("/")
+        for i in range(1, len(parts) + 1):
+            all_paths.add("/".join(parts[:i]))
+    for path in sorted(all_paths):
+        depth = path.count("/")
+        label = "  " * depth + path.rsplit("/", 1)[-1]
+        yield label, path, stages.get(path)
+
+
+def render_report(events: list[dict]) -> str:
+    stages = aggregate_spans(events)
+    lines: list[str] = []
+    if stages:
+        header = (
+            f"{'stage':<32} {'count':>7} {'total_s':>10} {'mean_s':>9} "
+            f"{'p50_s':>9} {'p90_s':>9} {'p99_s':>9}"
+        )
+        lines.append(header)
+        lines.append("-" * len(header))
+        for label, _path, hist in _tree_rows(stages):
+            if hist is None:
+                lines.append(label)
+                continue
+            s = hist.snapshot()
+            lines.append(
+                f"{label:<32} {s['count']:>7} {s['sum']:>10.4f} "
+                f"{s['mean']:>9.5f} {s['p50']:>9.5f} {s['p90']:>9.5f} "
+                f"{s['p99']:>9.5f}"
+            )
+    else:
+        lines.append("no span events found")
+
+    snapshots = [e for e in events if e.get("event") == "telemetry.snapshot"]
+    if snapshots:
+        last = snapshots[-1]
+        hists = last.get("histograms") or {}
+        if hists:
+            lines.append("")
+            lines.append("histograms (last snapshot):")
+            for name, h in sorted(hists.items()):
+                if not isinstance(h, dict) or not h.get("count"):
+                    continue
+                lines.append(
+                    f"  {name:<32} n={h['count']:<7} "
+                    f"mean={h.get('mean', 0.0):.5f} "
+                    f"p50={h.get('p50', 0.0):.5f} "
+                    f"p99={h.get('p99', 0.0):.5f}"
+                )
+        counters = last.get("counters") or {}
+        if counters:
+            lines.append("")
+            lines.append("counters (last snapshot):")
+            for name, value in sorted(counters.items()):
+                lines.append(f"  {name:<40} {value}")
+        gauges = last.get("gauges") or {}
+        if gauges:
+            lines.append("")
+            lines.append("gauges (last snapshot):")
+            for name, series in sorted(gauges.items()):
+                for labels, value in sorted(series.items()):
+                    tag = f"{name}{{{labels}}}" if labels else name
+                    lines.append(f"  {tag:<40} {value}")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1 or argv[0] in ("-h", "--help"):
+        print(
+            "usage: python -m spark_languagedetector_tpu.telemetry.report "
+            "<events.jsonl>",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        events = load_events(argv[0])
+    except OSError as e:
+        print(f"cannot read {argv[0]}: {e}", file=sys.stderr)
+        return 2
+    print(render_report(events))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

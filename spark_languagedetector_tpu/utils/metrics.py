@@ -21,6 +21,11 @@ from dataclasses import dataclass, field
 class Metrics:
     counters: dict[str, int] = field(default_factory=lambda: defaultdict(int))
     timers: dict[str, float] = field(default_factory=lambda: defaultdict(float))
+    # Invocations per timer, so mean latency is derivable (total alone can't
+    # distinguish "one slow call" from "many fast ones").
+    timer_counts: dict[str, int] = field(
+        default_factory=lambda: defaultdict(int)
+    )
     _lock: threading.Lock = field(
         default_factory=threading.Lock, repr=False, compare=False
     )
@@ -50,18 +55,31 @@ class Metrics:
             dt = time.perf_counter() - t0
             with self._lock:
                 self.timers[name] += dt
+                self.timer_counts[name] += 1
 
     def throughput(self, counter: str, timer: str) -> float:
         """counter/sec over accumulated timer time; 0.0 if never timed."""
         elapsed = self.timers.get(timer, 0.0)
         return self.counters.get(counter, 0) / elapsed if elapsed > 0 else 0.0
 
+    def mean_seconds(self, timer: str) -> float:
+        """Mean duration of one timed region; 0.0 if never timed."""
+        n = self.timer_counts.get(timer, 0)
+        return self.timers.get(timer, 0.0) / n if n else 0.0
+
     def snapshot(self) -> dict:
-        return {"counters": dict(self.counters), "timers": dict(self.timers)}
+        # Shape-compatible superset: "counters"/"timers" keep their original
+        # {name: number} form; "timer_counts" rides alongside.
+        return {
+            "counters": dict(self.counters),
+            "timers": dict(self.timers),
+            "timer_counts": dict(self.timer_counts),
+        }
 
     def reset(self) -> None:
         self.counters.clear()
         self.timers.clear()
+        self.timer_counts.clear()
 
 
 # Framework-global registry (scorers attach their own Metrics too).

@@ -93,6 +93,40 @@ def test_directory_and_selector_targets(tmp_path, capsys, monkeypatch):
     assert per_test[0]["tpu_hw_test"] == "test_two"
 
 
+def test_smoke_telemetry_emits_breakdown_block(tmp_path):
+    """The bench's telemetry contract: its smoke path (same emission code
+    the real configs use) writes a telemetry JSONL and returns the result
+    with a per-stage breakdown block covering >= 4 distinct stages that
+    span both fit and score — and the report CLI renders that tree."""
+    import io
+    from contextlib import redirect_stdout
+
+    from spark_languagedetector_tpu.telemetry.report import main as report_main
+
+    jsonl = str(tmp_path / "telemetry.jsonl")
+    result = bench.smoke_telemetry(jsonl)
+    tele = result["telemetry"]
+    assert tele["jsonl"] == jsonl
+    stages = tele["stages"]
+    assert len(stages) >= 4
+    assert any(p == "fit" or p.startswith("fit/") for p in stages)
+    assert any(p == "score" or p.startswith("score/") for p in stages)
+    for stats in stages.values():
+        assert stats["count"] >= 1 and stats["total_s"] >= 0
+        assert "p50" in stats and "p99" in stats
+    # The JSONL the block points at feeds the report CLI.
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert report_main([jsonl]) == 0
+    out = buf.getvalue()
+    rendered = [
+        p for p in stages
+        if "/" not in p or p.rsplit("/", 1)[-1] in out
+    ]
+    assert len(rendered) >= 4
+    assert "fit" in out and "score" in out
+
+
 def test_opt_out_and_low_budget_skip(tmp_path, capsys, monkeypatch):
     monkeypatch.setenv("SLD_TPU_TESTS", "0")
     bench.run_tpu_hw_tests(9999.0, test_path=str(tmp_path / "none.py"))

@@ -140,6 +140,58 @@ def test_kafka_source_gated_on_missing_dependency():
         next(kafka_source("topic", 10))
 
 
+def test_stream_prefetch_worker_spans_attach_to_stream_parent():
+    """Telemetry spans from prefetch worker threads must land under the
+    engine's "stream" root (explicit-parent attachment), with exact counts
+    — concurrent workers must never cross-wire or corrupt the span tree."""
+    from spark_languagedetector_tpu.telemetry import REGISTRY
+
+    REGISTRY.reset()
+    captured = []
+    REGISTRY.add_sink(type("S", (), {"emit": staticmethod(captured.append)})())
+    try:
+        rows = [{"fulltext": "ababab"}, {"fulltext": "xyxy"}] * 20
+        query = run_stream(
+            _model(),
+            memory_source(rows, batch_rows=4),
+            sink=lambda t: None,
+            prefetch=3,
+            workers=3,
+        )
+    finally:
+        REGISTRY.clear_sinks()
+    assert query.batches == 10
+    stages = REGISTRY.stage_summary()
+    # Worker transforms attach under stream/ — never as parentless roots.
+    assert stages["stream/transform"]["count"] == 10
+    assert stages["stream/batch"]["count"] == 10
+    assert stages["stream/batch/sink"]["count"] == 10
+    assert stages["stream"]["count"] == 1
+    assert "transform" not in stages  # no orphaned root spans
+    # The runner's nested scoring spans keep their own subtree.
+    assert stages["stream/transform/score"]["count"] == 10
+    span_paths = {e["path"] for e in captured if e["event"] == "telemetry.span"}
+    assert {"stream", "stream/transform", "stream/batch",
+            "stream/batch/sink"} <= span_paths
+    # Queue-depth and stall distributions were recorded per batch.
+    snap = REGISTRY.snapshot()
+    assert snap["histograms"]["stream/queue_depth"]["count"] == 10
+    assert snap["histograms"]["stream/prefetch_stall_s"]["count"] == 10
+
+
+def test_stream_synchronous_path_records_spans_without_stall():
+    from spark_languagedetector_tpu.telemetry import REGISTRY
+
+    REGISTRY.reset()
+    rows = [{"fulltext": "ab"}] * 6
+    run_stream(_model(), memory_source(rows, 2), sink=lambda t: None,
+               prefetch=0)
+    stages = REGISTRY.stage_summary()
+    assert stages["stream/transform"]["count"] == 3
+    # No futures → no prefetch stalls recorded.
+    assert "stream/prefetch_stall_s" not in REGISTRY.snapshot()["histograms"]
+
+
 def test_stream_explicit_single_worker_preserves_order():
     """workers=1 forces serial transforms (the conservative pipeline)."""
     rows = [{"fulltext": "ababab"}, {"fulltext": "xyxy"}] * 10

@@ -1,0 +1,744 @@
+"""Telemetry subsystem tests: spans, histograms, gauges, exporters, report.
+
+Span trees are aggregation-by-path (no shared mutable tree), so the
+threading tests assert the property that actually matters: every span
+lands under its intended parent path with the right count, no matter how
+many worker threads interleave.
+"""
+
+import json
+import math
+import os
+import re
+import threading
+
+import numpy as np
+import pytest
+
+from spark_languagedetector_tpu.telemetry import (
+    Histogram,
+    Registry,
+    current_span,
+    render_prometheus,
+    span,
+    write_prometheus,
+)
+from spark_languagedetector_tpu.telemetry.export import (
+    JsonlSink,
+    configure_sinks_from_env,
+    parse_sink_spec,
+)
+from spark_languagedetector_tpu.telemetry.report import (
+    aggregate_spans,
+    load_events,
+    main as report_main,
+    render_report,
+)
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "telemetry_fixture.jsonl")
+
+
+# ------------------------------------------------------------------ spans ----
+def test_span_nesting_builds_slash_paths():
+    reg = Registry()
+    with span("score", registry=reg):
+        with span("pack", registry=reg):
+            pass
+        # A name already carrying the parent prefix is used verbatim —
+        # the ISSUE's span("score/pack") call shape.
+        with span("score/dispatch", registry=reg):
+            pass
+    assert set(reg.histograms) == {
+        "span:score", "span:score/pack", "span:score/dispatch"
+    }
+
+
+def test_span_standalone_full_path_names_are_roots():
+    reg = Registry()
+    with span("score/pack", registry=reg):
+        pass
+    assert set(reg.histograms) == {"span:score/pack"}
+
+
+def test_span_full_path_names_merge_under_rerooted_parent():
+    """A call site naming spans by full path ("score/pack") still nests
+    cleanly when its root span is itself re-rooted under another stage
+    (stream/transform/score) — shared segments merge, never duplicate."""
+    reg = Registry()
+    with span("stream", registry=reg):
+        with span("stream/transform", registry=reg):
+            with span("score", registry=reg) as score_root:
+                with span("score/pack", parent=score_root, registry=reg):
+                    pass
+    assert "span:stream/transform/score/pack" in reg.histograms
+    assert not any("score/score" in k for k in reg.histograms)
+
+
+def test_current_span_tracks_innermost():
+    reg = Registry()
+    assert current_span() is None
+    with span("a", registry=reg) as a:
+        assert current_span() is a
+        with span("b", registry=reg) as b:
+            assert current_span() is b
+        assert current_span() is a
+    assert current_span() is None
+
+
+def test_span_attrs_ride_on_events():
+    reg = Registry()
+    seen = []
+    reg.add_sink(type("S", (), {"emit": staticmethod(seen.append)})())
+    with span("stage", registry=reg, rows=7) as sp:
+        sp.set(extra="x")
+    (ev,) = seen
+    assert ev["event"] == "telemetry.span"
+    assert ev["path"] == "stage" and ev["rows"] == 7 and ev["extra"] == "x"
+    assert ev["wall_s"] >= 0
+
+
+def test_span_nesting_across_threads_attaches_to_explicit_parent():
+    """Worker-thread spans passed an explicit parent land under it; the
+    aggregate counts stay exact under concurrency (no tree corruption)."""
+    reg = Registry()
+    n_threads, per_thread = 8, 25
+    barrier = threading.Barrier(n_threads)
+
+    with span("stream", registry=reg) as root:
+        def worker():
+            barrier.wait()
+            for _ in range(per_thread):
+                with span("stream/transform", parent=root, registry=reg):
+                    with span("inner", registry=reg):
+                        pass
+        threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    total = n_threads * per_thread
+    assert reg.histograms["span:stream/transform"].count == total
+    assert reg.histograms["span:stream/transform/inner"].count == total
+    assert reg.histograms["span:stream"].count == 1
+    # No stray paths: concurrency must not cross-wire parents.
+    assert set(reg.histograms) == {
+        "span:stream", "span:stream/transform", "span:stream/transform/inner"
+    }
+
+
+def test_span_in_fresh_thread_without_parent_is_root():
+    reg = Registry()
+    with span("outer", registry=reg):
+        def run():
+            with span("orphan", registry=reg):
+                pass
+        t = threading.Thread(target=run)
+        t.start()
+        t.join()
+    assert "span:orphan" in reg.histograms  # not outer/orphan
+
+
+def test_span_fence_records_device_seconds():
+    reg = Registry()
+
+    class FakeDeviceArray:
+        blocked = 0
+        def block_until_ready(self):
+            FakeDeviceArray.blocked += 1
+
+    with span("dispatch", registry=reg, fence=True) as sp:
+        sp.fence(FakeDeviceArray(), None, FakeDeviceArray())
+    assert FakeDeviceArray.blocked == 2
+    assert reg.histograms["span_device:dispatch"].count == 1
+    # wall_s <= device_s by construction
+    wall = reg.histograms["span:dispatch"]
+    dev = reg.histograms["span_device:dispatch"]
+    assert dev.total >= wall.total
+
+
+def test_span_fence_disabled_by_default():
+    reg = Registry()
+
+    class FakeDeviceArray:
+        blocked = 0
+        def block_until_ready(self):
+            FakeDeviceArray.blocked += 1
+
+    with span("dispatch", registry=reg) as sp:
+        sp.fence(FakeDeviceArray())
+    assert FakeDeviceArray.blocked == 0
+    assert "span_device:dispatch" not in reg.histograms
+
+
+def test_span_fence_env_opt_in(monkeypatch):
+    from spark_languagedetector_tpu.telemetry import FENCE_ENV
+
+    monkeypatch.setenv(FENCE_ENV, "1")
+    reg = Registry()
+
+    class FakeDeviceArray:
+        blocked = 0
+        def block_until_ready(self):
+            FakeDeviceArray.blocked += 1
+
+    with span("dispatch", registry=reg) as sp:
+        sp.fence(FakeDeviceArray())
+    assert FakeDeviceArray.blocked == 1
+
+
+def test_span_records_on_exception():
+    reg = Registry()
+    with pytest.raises(ValueError):
+        with span("boom", registry=reg):
+            raise ValueError("x")
+    assert reg.histograms["span:boom"].count == 1
+
+
+# -------------------------------------------------------------- histogram ----
+def test_histogram_exact_percentiles_within_reservoir():
+    h = Histogram()
+    values = np.arange(1, 501, dtype=float)
+    for v in np.random.default_rng(0).permutation(values):
+        h.record(v)
+    assert h.count == 500
+    assert h.total == pytest.approx(values.sum())
+    assert h.min == 1 and h.max == 500
+    assert h.percentile(50) == 250
+    assert h.percentile(90) == 450
+    assert h.percentile(99) == 495
+
+
+def test_histogram_reservoir_approximation_beyond_cap():
+    h = Histogram()
+    for v in np.random.default_rng(1).permutation(np.arange(10_000.0)):
+        h.record(v)
+    assert h.count == 10_000
+    assert h.min == 0 and h.max == 9999
+    # Uniform reservoir of 512: percentiles land near truth.
+    assert abs(h.percentile(50) - 5000) < 800
+    assert h.percentile(99) > 9000
+
+
+def test_histogram_deterministic_across_runs():
+    def run():
+        h = Histogram()
+        for v in range(5000):
+            h.record(float(v % 997))
+        return h.percentile(50), h.percentile(99)
+    assert run() == run()
+
+
+def test_histogram_empty_snapshot():
+    h = Histogram()
+    assert h.snapshot() == {"count": 0, "sum": 0.0}
+    assert math.isnan(h.percentile(50))
+
+
+# --------------------------------------------------------------- registry ----
+def test_registry_counters_and_gauges():
+    reg = Registry()
+    reg.incr("score/retries")
+    reg.incr("score/retries", 2)
+    reg.set_gauge("live_buffer_bytes", 100.0, device="cpu:0")
+    reg.set_gauge("live_buffer_bytes", 200.0, device="cpu:0")  # last wins
+    snap = reg.snapshot()
+    assert snap["counters"]["score/retries"] == 3
+    assert snap["gauges"]["live_buffer_bytes"] == {"device=cpu:0": 200.0}
+
+
+def test_registry_stage_summary_only_spans():
+    reg = Registry()
+    reg.observe("score/batch_fill_ratio", 0.5)
+    with span("fit/count", registry=reg):
+        pass
+    summary = reg.stage_summary()
+    assert list(summary) == ["fit/count"]
+    assert summary["fit/count"]["count"] == 1
+
+
+def test_registry_thread_safety_under_contention():
+    reg = Registry()
+    n, per = 8, 1000
+    def work():
+        for i in range(per):
+            reg.incr("c")
+            reg.observe("h", float(i))
+    threads = [threading.Thread(target=work) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert reg.counters["c"] == n * per
+    assert reg.histograms["h"].count == n * per
+
+
+# -------------------------------------------------------------- exporters ----
+def test_jsonl_sink_valid_json_and_monotonic_timestamps(tmp_path):
+    reg = Registry()
+    path = str(tmp_path / "events.jsonl")
+    reg.add_sink(JsonlSink(path))
+    for i in range(50):
+        with span("s", registry=reg, i=i):
+            pass
+    reg.flush()
+    lines = open(path).read().splitlines()
+    events = [json.loads(l) for l in lines]  # every line parses
+    assert len(events) == 51
+    assert all("event" in e and "ts" in e for e in events)
+    tss = [e["ts"] for e in events]
+    assert all(a < b for a, b in zip(tss, tss[1:])), "ts must strictly increase"
+
+
+def test_sink_failure_never_propagates_into_recording():
+    """Span exit emits from inside production fit/score/stream paths — a
+    dying sink (disk full, closed file) must drop events, not take down
+    the computation it observes."""
+    reg = Registry()
+
+    class DyingSink:
+        def emit(self, event):
+            raise OSError("disk full")
+
+        def write_snapshot(self, registry):
+            raise OSError("disk full")
+
+    reg.add_sink(DyingSink())
+    with pytest.warns(RuntimeWarning, match="dropping events"):
+        with span("score/pack", registry=reg):
+            pass
+    reg.flush()  # snapshot-sink failure contained too
+    assert reg.histograms["span:score/pack"].count == 1  # still aggregated
+    assert reg.counters["telemetry/sink_errors"] >= 2
+
+
+def test_flush_snapshot_carries_plain_histograms(tmp_path):
+    """The JSONL snapshot must carry the non-span histograms — fill ratio
+    and friends are collected per batch but have no per-event record, so
+    omitting them here would strand them in process memory."""
+    reg = Registry()
+    path = str(tmp_path / "events.jsonl")
+    reg.add_sink(JsonlSink(path))
+    reg.observe("score/batch_fill_ratio", 0.75)
+    with span("score/pack", registry=reg):
+        pass
+    reg.flush()
+    snap_ev = [json.loads(l) for l in open(path)][-1]
+    assert snap_ev["event"] == "telemetry.snapshot"
+    hists = snap_ev["histograms"]
+    assert hists["score/batch_fill_ratio"]["count"] == 1
+    assert hists["score/batch_fill_ratio"]["p50"] == pytest.approx(0.75)
+    # Span distributions ride as per-span events, not snapshot payload.
+    assert not any(k.startswith("span:") for k in hists)
+    report = render_report([snap_ev])
+    assert "histograms (last snapshot):" in report
+    assert "score/batch_fill_ratio" in report
+
+
+def test_jsonl_sink_log_event_schema_compatible(tmp_path):
+    """Span events carry the same discriminator shape utils.logging events
+    do: a string 'event' plus float 'ts' — scrapers need no new parser."""
+    reg = Registry()
+    path = str(tmp_path / "events.jsonl")
+    reg.add_sink(JsonlSink(path))
+    with span("s", registry=reg):
+        pass
+    (ev,) = [json.loads(l) for l in open(path)]
+    assert isinstance(ev["event"], str) and isinstance(ev["ts"], float)
+
+
+# Minimal Prometheus text-format validator: TYPE lines + sample lines.
+_PROM_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})?\s+(-?\d+(\.\d+)?([eE][+-]?\d+)?|NaN)$"
+)
+_PROM_TYPE = re.compile(r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (summary|counter|gauge)$")
+
+
+def test_prometheus_snapshot_parses(tmp_path):
+    reg = Registry()
+    with span("score/pack", registry=reg):
+        pass
+    reg.observe("score/batch_fill_ratio", 0.8)
+    reg.incr("score/retries")
+    reg.set_gauge("live_buffer_bytes", 4096.0, device="cpu:0")
+    text = render_prometheus(reg)
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if line.startswith("#"):
+            assert _PROM_TYPE.match(line), line
+        else:
+            assert _PROM_SAMPLE.match(line), line
+    # Round-trip essentials are present.
+    assert 'langdetect_span_seconds_count{path="score/pack"} 1' in text
+    assert 'langdetect_counter_total{name="score/retries"} 1' in text
+    assert 'langdetect_gauge{name="live_buffer_bytes",device="cpu:0"}' in text
+    # Snapshot writer writes the same content atomically.
+    out = tmp_path / "metrics.prom"
+    write_prometheus(reg, str(out))
+    assert out.read_text() == text
+
+
+def test_prometheus_label_escaping():
+    reg = Registry()
+    reg.incr('weird"name\\with\nstuff')
+    text = render_prometheus(reg)
+    assert '\\"' in text and "\\\\" in text and "\\n" in text
+    for line in text.splitlines():
+        if not line.startswith("#"):
+            assert _PROM_SAMPLE.match(line), line
+
+
+def test_prometheus_gauge_labels_survive_comma_values(tmp_path):
+    """A label value containing commas/equals (a full TPU device repr)
+    must not shatter into bogus label tokens — every emitted line stays
+    valid exposition format and the value survives intact."""
+    reg = Registry()
+    reg.set_gauge(
+        "live_buffer_bytes", 512.0,
+        device="TpuDevice(id=0, process_index=0, coords=(0,0,0))",
+    )
+    text = render_prometheus(reg)
+    for line in text.splitlines():
+        if not line.startswith("#"):
+            assert _PROM_SAMPLE.match(line), line
+    assert (
+        'device="TpuDevice(id=0, process_index=0, coords=(0,0,0))"' in text
+    )
+
+
+def test_fenced_device_timings_reach_summary_and_prometheus():
+    """device_s histograms must surface in the aggregate views — the
+    bench stage breakdown and the .prom snapshot — not just raw JSONL."""
+    reg = Registry()
+
+    class FakeDeviceArray:
+        def block_until_ready(self):
+            pass
+
+    with span("score/dispatch", registry=reg, fence=True) as sp:
+        sp.fence(FakeDeviceArray())
+    entry = reg.stage_summary()["score/dispatch"]
+    assert entry["device_total_s"] >= entry["total_s"]
+    assert "device_p99" in entry
+    text = render_prometheus(reg)
+    assert "# TYPE langdetect_span_device_seconds summary" in text
+    assert 'langdetect_span_device_seconds_count{path="score/dispatch"} 1' in text
+    for line in text.splitlines():
+        if not line.startswith("#"):
+            assert _PROM_SAMPLE.match(line), line
+
+
+def test_import_survives_bad_sink_env(tmp_path):
+    """A broken LANGDETECT_METRICS_SINK must degrade to a warning — a
+    metrics env var taking down every import (scoring included) is a far
+    bigger failure than a metric-less run."""
+    import subprocess
+    import sys
+
+    blocker = tmp_path / "file"  # a *file*, so file/sub can't be a dir
+    blocker.write_text("")
+    for bad in ("bogus:/x", f"jsonl:{blocker}/sub/t.jsonl"):
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import spark_languagedetector_tpu.telemetry; print('ok')"],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "LANGDETECT_METRICS_SINK": bad},
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "ok" in proc.stdout
+        assert "could not attach metric sinks" in proc.stderr
+
+
+def test_sink_env_spec_parsing(tmp_path):
+    assert parse_sink_spec("jsonl:/a.jsonl,prom:/b.prom") == [
+        ("jsonl", "/a.jsonl"), ("prom", "/b.prom")
+    ]
+    with pytest.raises(ValueError):
+        parse_sink_spec("bogus:/x")
+    with pytest.raises(ValueError):
+        parse_sink_spec("jsonl")
+    reg = Registry()
+    jsonl = tmp_path / "t.jsonl"
+    prom = tmp_path / "t.prom"
+    sinks = configure_sinks_from_env(
+        reg, env={"LANGDETECT_METRICS_SINK": f"jsonl:{jsonl},prom:{prom}"}
+    )
+    assert [s.kind for s in sinks] == ["jsonl", "prom"]
+    with span("s", registry=reg):
+        pass
+    reg.flush()
+    assert jsonl.exists() and prom.exists()
+    assert "langdetect_span_seconds" in prom.read_text()
+
+
+# ----------------------------------------------------------------- gauges ----
+def test_sample_device_gauges_cpu():
+    import jax.numpy as jnp
+
+    from spark_languagedetector_tpu.telemetry.gauges import sample_device_gauges
+
+    reg = Registry()
+    keep = jnp.ones((128, 128), jnp.float32)  # ensure something is live
+    out = sample_device_gauges(reg)
+    assert "live_buffer_bytes" in out
+    assert sum(out["live_buffer_bytes"].values()) >= keep.nbytes
+    assert "live_buffer_bytes" in reg.snapshot()["gauges"]
+
+
+def test_install_jax_hooks_counts_compiles():
+    import jax
+    import jax.numpy as jnp
+
+    from spark_languagedetector_tpu.telemetry import REGISTRY, install_jax_hooks
+
+    assert install_jax_hooks()  # global listener → global registry
+    before = REGISTRY.counters.get("jax/compile_events", 0)
+
+    @jax.jit
+    def f(x):
+        return x * 2 + 1
+
+    f(jnp.arange(7)).block_until_ready()
+    assert REGISTRY.counters.get("jax/compile_events", 0) > before
+
+
+def test_jax_hook_duration_counts_only_backend_compiles():
+    """The duration listener must exact-match the backend compile event:
+    jax emits three per-compile duration events whose names all contain
+    "compile", plus a compile_time_SAVED event on persistent-cache hits —
+    substring matching would triple-count and bill savings as spend."""
+    from jax import monitoring
+
+    from spark_languagedetector_tpu.telemetry import (
+        REGISTRY, install_jax_hooks,
+    )
+    from spark_languagedetector_tpu.telemetry.gauges import (
+        _BACKEND_COMPILE_EVENT,
+    )
+
+    reg = Registry()
+    try:
+        assert install_jax_hooks(reg)
+        for lookalike in (
+            "/jax/core/compile/jaxpr_trace_duration",
+            "/jax/core/compile/jaxpr_to_mlir_module_duration",
+            "/jax/compilation_cache/compile_time_saved_sec",
+        ):
+            monitoring.record_event_duration_secs(lookalike, 123.0)
+        assert reg.counters.get("jax/compile_events", 0) == 0
+        assert "jax/compile_s" not in reg.histograms
+        monitoring.record_event_duration_secs(_BACKEND_COMPILE_EVENT, 0.25)
+        assert reg.counters["jax/compile_events"] == 1
+        assert reg.histograms["jax/compile_s"].total == pytest.approx(0.25)
+    finally:
+        install_jax_hooks(REGISTRY)  # restore the process-global binding
+
+
+def test_install_jax_hooks_rebinds_to_latest_registry():
+    """jax listener registration is permanent, so a later install call
+    with a different registry must redirect the flow — not silently keep
+    feeding the first caller's registry while returning True."""
+    from jax import monitoring
+
+    from spark_languagedetector_tpu.telemetry import (
+        REGISTRY, install_jax_hooks,
+    )
+
+    first, second = Registry(), Registry()
+    try:
+        assert install_jax_hooks(first)
+        monitoring.record_event("/jax/compilation_cache/cache_misses")
+        assert first.counters["jax/compile_cache_misses"] == 1
+        assert install_jax_hooks(second)
+        monitoring.record_event("/jax/compilation_cache/cache_misses")
+        assert second.counters["jax/compile_cache_misses"] == 1
+        assert first.counters["jax/compile_cache_misses"] == 1  # unchanged
+    finally:
+        install_jax_hooks(REGISTRY)
+
+
+def test_device_label_is_short_and_comma_free():
+    from spark_languagedetector_tpu.telemetry.gauges import _device_label
+
+    class FakeTpu:
+        platform = "tpu"
+        id = 3
+        def __str__(self):
+            return "TpuDevice(id=3, process_index=0, coords=(1,1,0))"
+
+    class Weird:
+        def __str__(self):
+            return "mystery-device"
+
+    assert _device_label(FakeTpu()) == "tpu:3"
+    assert _device_label(Weird()) == "mystery-device"
+
+
+def test_note_donation_reuse():
+    from spark_languagedetector_tpu.telemetry.gauges import note_donation_reuse
+
+    reg = Registry()
+
+    class Deleted:
+        def is_deleted(self):
+            return True
+
+    class Alive:
+        def is_deleted(self):
+            return False
+
+    assert note_donation_reuse(Deleted(), reg) is True
+    assert note_donation_reuse(Alive(), reg) is False
+    assert note_donation_reuse(object(), reg) is False  # unobservable
+    assert reg.counters["jax/donated_reuse"] == 1
+    assert reg.counters["jax/donated_copy"] == 1
+
+
+# ------------------------------------------------------------- report CLI ----
+def test_report_cli_on_checked_in_fixture(capsys):
+    """Tier-1-safe smoke: the report CLI renders the fixture's stage tree."""
+    rc = report_main([FIXTURE])
+    assert rc == 0
+    out = capsys.readouterr().out
+    for stage in ("fit", "count", "topk", "score", "pack", "dispatch", "fetch"):
+        assert re.search(rf"^\s*{stage}\b", out, re.M), f"missing {stage}:\n{out}"
+    assert "counters (last snapshot):" in out
+    assert "jax/compile_events" in out
+    assert "live_buffer_bytes" in out
+    assert "histograms (last snapshot):" in out
+    assert "score/batch_fill_ratio" in out
+
+
+def test_report_aggregates_fixture_percentiles():
+    events = load_events(FIXTURE)
+    stages = aggregate_spans(events)
+    assert stages["score/pack"].count == 2
+    assert stages["score/pack"].percentile(50) == pytest.approx(0.0019)
+    assert stages["fit/count"].count == 1
+
+
+def test_report_cli_usage_and_missing_file(capsys, tmp_path):
+    assert report_main([]) == 2
+    assert report_main([str(tmp_path / "nope.jsonl")]) == 2
+    assert report_main(["-h"]) == 2
+
+
+def test_report_skips_garbage_lines(tmp_path, capsys):
+    p = tmp_path / "t.jsonl"
+    p.write_text(
+        '{"event": "telemetry.span", "path": "a", "wall_s": 0.1, "ts": 1.0}\n'
+        "this is not json\n"
+        '{"event": "telemetry.span", "path": "a", "wall_s": 0.3, "ts": 2.0}\n'
+    )
+    events = load_events(str(p))
+    assert len(events) == 2
+    report = render_report(events)
+    assert re.search(r"^a\s+2\b", report, re.M)
+
+
+# ------------------------------------------------------- metrics satellite ----
+def test_metrics_timer_accumulates_count_and_mean():
+    from spark_languagedetector_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    for _ in range(4):
+        with m.timer("score_s"):
+            pass
+    snap = m.snapshot()
+    assert isinstance(snap["timers"]["score_s"], float)  # legacy shape kept
+    assert snap["timer_counts"]["score_s"] == 4
+    assert m.mean_seconds("score_s") == pytest.approx(
+        snap["timers"]["score_s"] / 4
+    )
+    assert m.mean_seconds("never") == 0.0
+    m.reset()
+    assert m.snapshot()["timer_counts"] == {}
+
+
+def test_metrics_pickle_roundtrip_keeps_counts():
+    import pickle
+
+    from spark_languagedetector_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    with m.timer("t"):
+        pass
+    m2 = pickle.loads(pickle.dumps(m))
+    assert m2.timer_counts["t"] == 1
+    with m2.timer("t"):
+        pass  # lock was rebuilt
+    assert m2.timer_counts["t"] == 2
+
+
+# ------------------------------------------------- end-to-end instrumentation -
+def test_runner_score_records_stage_spans_and_histograms():
+    from spark_languagedetector_tpu import LanguageDetectorModel, Table
+    from spark_languagedetector_tpu.telemetry import REGISTRY
+
+    REGISTRY.reset()
+    model = LanguageDetectorModel.from_gram_map(
+        {b"ab": [1.0, 0.0], b"xy": [0.0, 1.0]}, [2], ["a", "x"]
+    )
+    out = model.transform(Table({"fulltext": ["ababab", "xyxy"] * 20}))
+    assert list(out.column("lang")) == ["a", "x"] * 20
+    stages = REGISTRY.stage_summary()
+    for path in ("score", "score/pack", "score/dispatch", "score/fetch"):
+        assert path in stages, stages
+    snap = REGISTRY.snapshot()
+    assert snap["histograms"]["score/batch_fill_ratio"]["count"] >= 1
+    assert snap["histograms"]["score/padding_waste"]["count"] >= 1
+    assert snap["histograms"]["score/batch_latency_s"]["count"] >= 1
+    fill = snap["histograms"]["score/batch_fill_ratio"]
+    assert 0.0 < fill["p50"] <= 1.0
+
+
+def test_fit_records_stage_spans_host_and_device():
+    import numpy as np
+
+    from spark_languagedetector_tpu.ops.fit import fit_profile_numpy
+    from spark_languagedetector_tpu.ops.fit_tpu import fit_profile_device
+    from spark_languagedetector_tpu.ops.vocab import EXACT, VocabSpec
+    from spark_languagedetector_tpu.telemetry import REGISTRY
+
+    REGISTRY.reset()
+    docs = [b"abab", b"xyxy", b"abxy", b"xyab"]
+    langs = np.asarray([0, 1, 0, 1])
+    spec = VocabSpec(EXACT, (1, 2))
+    fit_profile_numpy(docs, langs, 2, spec, 50)
+    stages = REGISTRY.stage_summary()
+    for path in ("fit/count", "fit/weights", "fit/topk"):
+        assert path in stages, stages
+
+    REGISTRY.reset()
+    ids_h, w_h = fit_profile_numpy(docs, langs, 2, spec, 50)
+    ids_d, w_d = fit_profile_device(docs, langs, 2, spec, 50)
+    np.testing.assert_array_equal(ids_h, ids_d)
+    stages = REGISTRY.stage_summary()
+    for path in ("fit/count", "fit/topk", "fit/collect"):
+        assert path in stages, stages
+
+
+def test_split_fit_records_host_half_and_merge():
+    """The exact n>=4 split fit must attribute its host long-gram pass —
+    often the dominant stage — not just the device half."""
+    import numpy as np
+
+    from spark_languagedetector_tpu.ops.fit_tpu import (
+        fit_profile_device_split,
+    )
+    from spark_languagedetector_tpu.ops.vocab import EXACT, VocabSpec
+    from spark_languagedetector_tpu.telemetry import REGISTRY
+
+    REGISTRY.reset()
+    docs = [b"abcde" * 3, b"vwxyz" * 3, b"abcdefgh", b"stuvwxyz"]
+    langs = np.asarray([0, 1, 0, 1])
+    spec = VocabSpec(EXACT, (1, 2, 3, 4, 5))
+    fit_profile_device_split(docs, langs, 2, spec, 100)
+    stages = REGISTRY.stage_summary()
+    for path in ("fit/count", "fit/weights", "fit/topk", "fit/merge"):
+        assert path in stages, stages
+    # Both halves land under fit/count: the device scatter-add loop and
+    # the host long-gram sweep.
+    assert stages["fit/count"]["count"] >= 2, stages["fit/count"]

@@ -657,11 +657,22 @@ def smoke_telemetry(jsonl_path: str | None = None) -> dict:
     returns the result dict with the telemetry block. Used by
     ``python bench.py --smoke-telemetry`` and the tier-1 suite — it must
     stay fast (~seconds) and accelerator-free.
+
+    Also exercises the flight recorder end to end: a streaming pass with a
+    failing sink drives the real crash path, and the post-mortem dump's
+    path/size land in the result (``flight_recorder`` block) so a smoke
+    run proves the whole observability stack, not just the happy path.
     """
     import tempfile
 
     from spark_languagedetector_tpu import LanguageDetector, Table
-    from spark_languagedetector_tpu.telemetry import REGISTRY, install_jax_hooks
+    from spark_languagedetector_tpu.telemetry import (
+        REGISTRY,
+        flightrec,
+        install_jax_hooks,
+        new_trace_id,
+        trace_request,
+    )
     from spark_languagedetector_tpu.telemetry.export import JsonlSink
 
     install_jax_hooks()
@@ -671,18 +682,64 @@ def smoke_telemetry(jsonl_path: str | None = None) -> dict:
     )
     sink = JsonlSink(path)
     REGISTRY.add_sink(sink)
+    # Arm a recorder for the crash leg unless the env already did; only an
+    # armed-by-us recorder is torn down on the way out.
+    _owned_recorder = flightrec.active() is None
+    if _owned_recorder:
+        flightrec.install(
+            os.path.join(
+                tempfile.gettempdir(), f"flightrec_smoke_{os.getpid()}"
+            )
+        )
     try:
         langs = language_names(3)
         docs, labels = make_corpus(langs, 60, mean_len=200, seed=3)
         det = LanguageDetector(langs, [1, 2], 200)
         model = det.fit(Table({"lang": labels, "fulltext": docs}))
-        out = model.transform(Table({"fulltext": docs}))
+        score_trace = new_trace_id()
+        with trace_request(score_trace):
+            out = model.transform(Table({"fulltext": docs}))
         assert len(out.column(model.get_output_col())) == len(docs)
-        return {"smoke": True, "docs": len(docs), **{
-            "telemetry": telemetry_block(path)
-        }}
+
+        # Flight-recorder leg: a sink that dies mid-stream takes the real
+        # crash path (run_stream's except hook dumps the ring).
+        from spark_languagedetector_tpu.stream.microbatch import (
+            memory_source,
+            run_stream,
+        )
+
+        def dying_sink(table):
+            raise RuntimeError("smoke-telemetry flight-recorder probe")
+
+        # last_dump_path is process-global: snapshot it first so a stale
+        # dump from an earlier crash can't masquerade as this leg's proof.
+        dump = None
+        prev_dump = flightrec.last_dump_path()
+        try:
+            run_stream(
+                model,
+                memory_source([{"fulltext": d} for d in docs[:20]], 10),
+                dying_sink,
+            )
+        except RuntimeError:
+            fresh = flightrec.last_dump_path()
+            if fresh is not None and fresh != prev_dump:
+                dump = fresh
+        flight = {"exercised": dump is not None}
+        if dump:
+            flight["dump"] = dump
+            with open(dump, "r", encoding="utf-8") as fh:
+                flight["events"] = sum(1 for _ in fh) - 1  # minus header
+        return {
+            "smoke": True,
+            "docs": len(docs),
+            "flight_recorder": flight,
+            "telemetry": {**telemetry_block(path), "trace_id": score_trace},
+        }
     finally:
         REGISTRY.remove_sink(sink)
+        if _owned_recorder:
+            flightrec.uninstall()
 
 
 # ------------------------------------------------------------ per config ----
@@ -989,24 +1046,32 @@ def run_config(num: int, deadline: float | None = None) -> dict:
             # consistently (fewer transform calls, deeper in-call pipelining;
             # 19.9k vs 13.7k rows/s on a cold wire, ~5% ahead when warm).
             for _ in range(7 if max(cfg["gram_lengths"]) <= 3 else 3):
-                lat: list[float] = []
+                lat: list[tuple[float, str | None]] = []
                 t0 = time.perf_counter()
                 q = run_stream(
                     model, memory_source(rows, 8192), sink_rows.append,
                     prefetch=6, workers=4,
+                    # Per-batch (seconds, trace id): the engine mints one
+                    # request trace per source batch, so the slowest batch
+                    # of the whole config is directly greppable in the
+                    # JSONL capture.
                     on_progress=lambda q, lat=lat: lat.append(
-                        q.last_batch_seconds
+                        (q.last_batch_seconds, q.last_batch_trace_id)
                     ),
                 )
                 times.append(time.perf_counter() - t0)
                 batch_lat.append(lat)
                 sink_rows.clear()
             t_dev = min(times)
+            all_lat = [entry for lat in batch_lat for entry in lat]
+            slow_trace_s, slow_trace_id = (
+                max(all_lat, key=lambda e: e[0]) if all_lat else (None, None)
+            )
             # Per-batch latency percentiles from the best pass — the one
             # latency-shaped metric a micro-batch engine should publish
             # (VERDICT r4 #8). Batch latency here = transform-or-wait +
             # sink, i.e. the sink-visible stall per 8192-row micro-batch.
-            best_lat = batch_lat[int(np.argmin(times))]
+            best_lat = [s for s, _ in batch_lat[int(np.argmin(times))]]
             lat_p50 = float(np.percentile(best_lat, 50)) if best_lat else None
             lat_p95 = float(np.percentile(best_lat, 95)) if best_lat else None
             device_dps = n_docs / t_dev
@@ -1067,11 +1132,25 @@ def run_config(num: int, deadline: float | None = None) -> dict:
             # odds that min-time lands in clear weather.
             n_passes = 8 if max(cfg["gram_lengths"]) <= 3 else 4
             pass_times = []
+            pass_traces = []
+            # Each timed pass is one request: its trace id ties the pass
+            # to every span it recorded in the JSONL capture, so the
+            # artifact's slowest_trace_id points at a greppable offender.
+            from spark_languagedetector_tpu.telemetry import (
+                new_trace_id,
+                trace_request,
+            )
+
             for _ in range(n_passes):
+                pass_tid = new_trace_id()
                 t0 = time.perf_counter()
-                ids = runner.predict_ids(docs_b)
+                with trace_request(pass_tid):
+                    ids = runner.predict_ids(docs_b)
                 pass_times.append(time.perf_counter() - t0)
+                pass_traces.append(pass_tid)
             t_dev = min(pass_times)
+            slow_trace_id = pass_traces[int(np.argmax(pass_times))]
+            slow_trace_s = max(pass_times)
             device_dps = n_docs / t_dev
             median_dps = n_docs / sorted(pass_times)[len(pass_times) // 2]
             parity = None
@@ -1237,8 +1316,13 @@ def run_config(num: int, deadline: float | None = None) -> dict:
                 result["latency_batch_rows"] = 8192
         # Stage-level breakdown (cumulative through this config) + the JSONL
         # event-log path, so the BENCH artifact localizes a regression to a
-        # stage instead of reporting one opaque end-to-end number.
+        # stage instead of reporting one opaque end-to-end number. The
+        # slowest request's trace id makes the worst pass/batch greppable
+        # in that JSONL (and renderable via the telemetry.tracing CLI).
         result["telemetry"] = telemetry_block(telemetry_jsonl)
+        if slow_trace_id is not None:
+            result["telemetry"]["slowest_trace_id"] = slow_trace_id
+            result["telemetry"]["slowest_trace_s"] = round(slow_trace_s, 4)
         return result
     finally:
         # The model cache outlives this config: never leak the cap.
@@ -1343,8 +1427,16 @@ def main():
     final.setdefault("metric", "langid docs/sec/chip (headline, config "
                      f"{order[-1] if order else '?'})")
     final.setdefault("unit", "docs/sec")
+    # Read-only: the configs' telemetry_setup already attached the sink
+    # (resetting aggregates here would wipe nothing useful but attaching a
+    # fresh never-written sink on the all-configs-failed path would).
     try:
-        final["telemetry_jsonl"] = telemetry_setup()
+        from spark_languagedetector_tpu.telemetry import REGISTRY
+
+        for sink in REGISTRY.sinks:
+            if getattr(sink, "kind", "") == "jsonl":
+                final["telemetry_jsonl"] = sink.path
+                break
     except Exception:
         pass
     final["summary"] = summary

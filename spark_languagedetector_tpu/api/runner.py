@@ -42,7 +42,7 @@ from ..ops.encoding import (
     unpack_ragged,
 )
 from ..ops.vocab import VocabSpec
-from ..telemetry import REGISTRY, span
+from ..telemetry import REGISTRY, flightrec, span, trace_request
 from ..utils.logging import get_logger, log_event
 from ..utils.metrics import Metrics
 
@@ -848,6 +848,17 @@ class BatchRunner:
         return self._execute(byte_docs, want_labels=True)
 
     def _execute(self, byte_docs: Sequence[bytes], *, want_labels: bool):
+        # Flight-recorder hook: a raising score call dumps the recent
+        # telemetry ring (when LANGDETECT_FLIGHT_RECORDER armed it) before
+        # propagating — the post-mortem shows the batches leading up to
+        # the failure, not just the exception.
+        try:
+            return self._execute_traced(byte_docs, want_labels=want_labels)
+        except Exception as e:
+            flightrec.record_crash("score", e)
+            raise
+
+    def _execute_traced(self, byte_docs: Sequence[bytes], *, want_labels: bool):
         if self.max_score_bytes:
             byte_docs = [
                 truncate_utf8(d, self.max_score_bytes) for d in byte_docs
@@ -1076,7 +1087,14 @@ class BatchRunner:
         # Per-call retry tally (list append is GIL-atomic, so dispatch
         # workers need no extra lock); the registry counter is lifetime.
         call_retries: list[int] = []
-        with trace(), self.metrics.timer("score_s"), span(
+        # One request trace per score call: reuses the ambient trace when
+        # the call rides inside a larger request (a stream batch), mints a
+        # fresh id otherwise. Every span below — including the dispatch
+        # workers' cross-thread pack/dispatch spans, which inherit through
+        # parent=score_span — stamps this id onto its JSONL record, so one
+        # slow request can be isolated from the aggregate percentiles.
+        with trace_request() as req_id, trace(label="score"), \
+                self.metrics.timer("score_s"), span(
             "score", docs=N, batches=len(plan), strategy=self.strategy
         ) as score_span:
             if workers > 1:
@@ -1168,7 +1186,20 @@ class BatchRunner:
             docs=N,
             chunks=len(chunks),
             batches=len(plan),
+            trace_id=req_id,
         )
+        # Roofline gauges, once per runner: XLA's cost model for this
+        # runner's dispatch program at a shape it actually ran, so
+        # stage_summary can state achieved-vs-peak utilization. Pure
+        # diagnostics — never allowed to fail the call.
+        if plan and not getattr(self, "_cost_recorded", False):
+            self._cost_recorded = True
+            try:
+                from ..telemetry import cost as cost_mod
+
+                cost_mod.record_runner_cost(self, len(plan[0][0]), plan[0][1])
+            except Exception:
+                pass
         return out
 
     def predict(self, byte_docs: Sequence[bytes], languages: Sequence[str]) -> list[str]:

@@ -34,7 +34,7 @@ from ..ops.vocab import (
     MAX_EXACT_GRAM_LEN,
     VocabSpec,
 )
-from ..telemetry import span
+from ..telemetry import flightrec, span, trace_request
 from ..utils.logging import get_logger, log_event
 from .profile import GramProfile
 
@@ -238,60 +238,19 @@ class LanguageDetector(_DetectorParams):
         lang_idx = np.asarray([lang_to_idx[l] for l in label_list])
         # Root telemetry span: the count/weights/topk stage spans recorded
         # by ops.fit / ops.fit_tpu nest under "fit" (docs/OBSERVABILITY.md).
-        with span(
-            "fit",
-            rows=dataset.num_rows,
-            backend=self.get("fitBackend"),
-            languages=len(supported),
-        ):
-            if self.get("fitBackend") == "device":
-                from ..api.runner import resolve_fit_mesh
-                from ..ops.fit_tpu import (
-                    fit_profile_device,
-                    fit_profile_device_split,
-                )
-
-                # More than one visible device ⇒ run the distributed
-                # training step on a data-parallel mesh (the reference's fit
-                # is cluster-parallel via Spark shuffles; VERDICT r1 #3).
-                mesh = resolve_fit_mesh()
-                if (
-                    spec.mode == EXACT
-                    and max(spec.gram_lengths) > MAX_DEVICE_ID_GRAM_LEN
-                ):
-                    # Exact n=4..5: no dense device table can hold the
-                    # long-gram id space — the split fit counts gram lengths
-                    # <= 3 on device and the long lengths through the exact
-                    # host path, merged with exact joint top-k (fit_tpu
-                    # docstring).
-                    ids, weights = fit_profile_device_split(
-                        docs,
-                        lang_idx,
-                        len(supported),
-                        spec,
-                        self.get("languageProfileSize"),
-                        self.get("weightMode"),
-                        mesh=mesh,
-                    )
-                else:
-                    ids, weights = fit_profile_device(
-                        docs,
-                        lang_idx,
-                        len(supported),
-                        spec,
-                        self.get("languageProfileSize"),
-                        self.get("weightMode"),
-                        mesh=mesh,
-                    )
-            else:
-                ids, weights = fit_ops.fit_profile_numpy(
-                    docs,
-                    lang_idx,
-                    len(supported),
-                    spec,
-                    self.get("languageProfileSize"),
-                    self.get("weightMode"),
-                )
+        # One request trace per fit; a raising fit dumps the flight
+        # recorder's ring (when armed) before propagating.
+        try:
+            with trace_request(), span(
+                "fit",
+                rows=dataset.num_rows,
+                backend=self.get("fitBackend"),
+                languages=len(supported),
+            ):
+                ids, weights = self._fit_profile(spec, docs, lang_idx, supported)
+        except Exception as e:
+            flightrec.record_crash("fit", e)
+            raise
         # Both modes store the compact columnar form (sorted unique ids +
         # weight rows); the device view picks dense-table vs LUT strategy.
         profile = GramProfile(
@@ -313,6 +272,56 @@ class LanguageDetector(_DetectorParams):
         if self.is_set("backend"):
             model.set("backend", self.get("backend"))
         return model
+
+    def _fit_profile(self, spec, docs, lang_idx, supported):
+        """(ids, weights) via the configured fit backend — the body of the
+        ``fit`` span (factored out so the crash hook wraps one site)."""
+        if self.get("fitBackend") == "device":
+            from ..api.runner import resolve_fit_mesh
+            from ..ops.fit_tpu import (
+                fit_profile_device,
+                fit_profile_device_split,
+            )
+
+            # More than one visible device ⇒ run the distributed
+            # training step on a data-parallel mesh (the reference's fit
+            # is cluster-parallel via Spark shuffles; VERDICT r1 #3).
+            mesh = resolve_fit_mesh()
+            if (
+                spec.mode == EXACT
+                and max(spec.gram_lengths) > MAX_DEVICE_ID_GRAM_LEN
+            ):
+                # Exact n=4..5: no dense device table can hold the
+                # long-gram id space — the split fit counts gram lengths
+                # <= 3 on device and the long lengths through the exact
+                # host path, merged with exact joint top-k (fit_tpu
+                # docstring).
+                return fit_profile_device_split(
+                    docs,
+                    lang_idx,
+                    len(supported),
+                    spec,
+                    self.get("languageProfileSize"),
+                    self.get("weightMode"),
+                    mesh=mesh,
+                )
+            return fit_profile_device(
+                docs,
+                lang_idx,
+                len(supported),
+                spec,
+                self.get("languageProfileSize"),
+                self.get("weightMode"),
+                mesh=mesh,
+            )
+        return fit_ops.fit_profile_numpy(
+            docs,
+            lang_idx,
+            len(supported),
+            spec,
+            self.get("languageProfileSize"),
+            self.get("weightMode"),
+        )
 
 
 class LanguageDetectorModel(HasInputCol, HasOutputCol):

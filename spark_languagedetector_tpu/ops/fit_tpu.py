@@ -351,6 +351,11 @@ def fit_profile_device(
     lang_arr = np.asarray(lang_indices, dtype=np.int32)
     order = np.argsort([len(d) for d in byte_docs], kind="stable")
     max_bucket = DEFAULT_LENGTH_BUCKETS[-1]
+    # (rows, pad_to) -> dispatch count: exactly the compiled-shape set, so
+    # the roofline gauges below bill the loop's true cost (billing every
+    # step at the largest shape overstates small/tail steps by orders of
+    # magnitude).
+    step_shapes: dict[tuple[int, int], int] = {}
     with span(
         "fit/count", docs=len(byte_docs), backend="device", shards=ndata
     ) as count_span:
@@ -368,6 +373,8 @@ def fit_profile_device(
             else:  # oversized docs: round up (recompiles per distinct width)
                 pad_to = -(-longest // 2048) * 2048
             batch, lengths = pad_batch(docs, pad_to=pad_to)
+            key = (len(docs), pad_to)
+            step_shapes[key] = step_shapes.get(key, 0) + 1
             prev = counts
             counts = step(
                 jnp.asarray(batch),
@@ -382,6 +389,18 @@ def fit_profile_device(
         # Count dispatch is async: fencing (opt-in) bills the span the
         # device_s through the last batch's completion.
         count_span.fence(counts)
+
+    # Roofline gauges for the count loop (single-device only — the GSPMD
+    # program's cost model is per-process): summed per-shape program cost
+    # over the shapes the loop actually dispatched, in the same units as
+    # the fit/count span. Diagnostics; never fatal.
+    if mesh is None and step_shapes:
+        try:
+            from ..telemetry import cost as cost_mod
+
+            cost_mod.record_fit_count_cost(spec, num_langs, step_shapes)
+        except Exception:
+            pass
 
     if extra_counts is not None:
         e_ids, e_langs, e_counts = (

@@ -21,6 +21,7 @@ LanguageDetector.scala:52-132) with exactly one collective.
 
 from __future__ import annotations
 
+import itertools
 from functools import partial
 
 import jax
@@ -68,17 +69,21 @@ def make_sharded_scorer(
         )
 
     ndata = int(mesh.shape[DATA_AXIS])
+    steps = itertools.count()
 
     def wrapper(batch, lengths, weights, lut=None):
         if lut is None:
             lut = jnp.zeros(0, jnp.int32)  # sentinel: dense direct indexing
         # Dispatch is one GSPMD program over every shard; the span carries
-        # the shard geometry (rows_per_shard × shards) and — under fencing
-        # — the device time through the slowest shard's completion.
+        # the shard geometry (rows_per_shard × shards), a per-wrapper step
+        # sequence (run-over-run ordering on a trace timeline), the
+        # ambient request trace id, and — under fencing — the device time
+        # through the slowest shard's completion.
         with span(
             "shard_score",
             shards=ndata,
             rows_per_shard=batch.shape[0] // ndata,
+            step=next(steps),
         ) as sp:
             out = scorer(batch, lengths, weights, lut)
             sp.fence(out)
@@ -119,12 +124,14 @@ def make_sharded_fit_step(
         )
 
     ndata = int(mesh.shape[DATA_AXIS])
+    steps = itertools.count()
 
     def timed_step(batch, lengths, lang_ids, counts_acc):
         with span(
             "shard_step",
             shards=ndata,
             rows_per_shard=batch.shape[0] // ndata,
+            step=next(steps),
         ) as sp:
             out = fit_step(batch, lengths, lang_ids, counts_acc)
             sp.fence(out)

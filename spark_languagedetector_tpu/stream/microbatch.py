@@ -22,7 +22,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Iterable, Iterator, Sequence
 
 from ..api.table import Table
-from ..telemetry import REGISTRY, span
+from ..telemetry import REGISTRY, flightrec, new_trace_id, span, trace_request
 from ..utils.logging import get_logger, log_event
 from ..utils.metrics import Metrics
 
@@ -88,6 +88,10 @@ class StreamingQuery:
     rows: int = 0
     last_batch_rows: int = 0
     last_batch_seconds: float = 0.0
+    # Request id of the batch the three last_batch_* fields describe —
+    # lets an on_progress hook tie a slow batch back to its spans in the
+    # JSONL capture (bench records the slowest one per config).
+    last_batch_trace_id: str | None = None
 
     @property
     def rows_per_second(self) -> float:
@@ -129,19 +133,22 @@ def run_stream(
     query = StreamingQuery()
     it = iter(source)
 
-    def transform_once(batch: Table, seq: int) -> Table:
+    def transform_once(batch: Table, seq: int, trace_id: str) -> Table:
         # Runs on a prefetch worker thread when the pipeline is deep: the
         # explicit parent pins the span under this run's "stream" root (a
         # fresh thread has no ambient span to inherit), so concurrent
-        # workers all aggregate under stream/transform.
-        with span(
+        # workers all aggregate under stream/transform. The per-batch
+        # trace id (minted when the batch was pulled) is rebound here so
+        # the nested runner score spans attribute to this batch's request
+        # rather than the stream root.
+        with trace_request(trace_id), span(
             "stream/transform", parent=stream_span, batch=seq,
             rows=batch.num_rows,
         ):
             try:
                 return model.transform(batch)
             except Exception:  # transient failure: replay once (stateless)
-                log_event(_log, "stream.retry", batch=seq)
+                log_event(_log, "stream.retry", batch=seq, trace_id=trace_id)
                 # May run on the worker thread concurrently with the
                 # caller's counter writes — Metrics serializes internally.
                 query.metrics.incr("retries")
@@ -152,7 +159,7 @@ def run_stream(
     executor = (
         ThreadPoolExecutor(max_workers=n_workers) if prefetch > 0 else None
     )
-    in_flight: deque = deque()  # (batch, seq, future-or-None)
+    in_flight: deque = deque()  # (batch, seq, trace_id, future-or-None)
     seq = 0
     try:
         with span(
@@ -173,12 +180,16 @@ def run_stream(
                     except StopIteration:
                         want_more = False
                 if batch is not None:
+                    # Each source batch is one request: its trace id is
+                    # minted at pull time and travels with the batch
+                    # through the prefetch worker and the drain loop.
+                    tid = new_trace_id()
                     fut = (
                         None
                         if executor is None
-                        else executor.submit(transform_once, batch, seq)
+                        else executor.submit(transform_once, batch, seq, tid)
                     )
-                    in_flight.append((batch, seq, fut))
+                    in_flight.append((batch, seq, tid, fut))
                     seq += 1
                 if not in_flight:
                     break
@@ -189,13 +200,15 @@ def run_stream(
                 if len(in_flight) > prefetch or not want_more or batch is None:
                     REGISTRY.observe("stream/queue_depth", len(in_flight))
                     REGISTRY.set_gauge("stream/queue_depth", len(in_flight))
-                    src, src_seq, fut = in_flight.popleft()
+                    src, src_seq, src_tid, fut = in_flight.popleft()
                     t0 = time.perf_counter()
-                    with query.metrics.timer("total_s"), span(
+                    with trace_request(src_tid), query.metrics.timer(
+                        "total_s"
+                    ), span(
                         "stream/batch", batch=src_seq, rows=src.num_rows
                     ):
                         if fut is None:
-                            out = transform_once(src, src_seq)
+                            out = transform_once(src, src_seq, src_tid)
                         else:
                             # Sink-visible stall: how long the drain sat
                             # waiting on the prefetch worker — the signal
@@ -214,6 +227,7 @@ def run_stream(
                     query.rows += src.num_rows
                     query.last_batch_rows = src.num_rows
                     query.last_batch_seconds = dt
+                    query.last_batch_trace_id = src_tid
                     query.metrics.incr("rows", src.num_rows)
                     query.metrics.incr("batches")
                     if on_progress is not None:
@@ -224,7 +238,14 @@ def run_stream(
                         n=query.batches,
                         rows=src.num_rows,
                         seconds=dt,
+                        trace_id=src_tid,
                     )
+    except Exception as e:
+        # Post-mortem: dump the flight-recorder ring (when armed) before
+        # the loop unwinds — a consuming source may make this failure
+        # unreplayable, so the recent-batch timeline is all there is.
+        flightrec.record_crash("stream", e)
+        raise
     finally:
         if executor is not None:
             # Don't wait for transforms of batches this run will never sink.

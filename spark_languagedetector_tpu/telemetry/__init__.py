@@ -21,8 +21,20 @@ table stakes):
   * exporters (:mod:`.export`) — a JSONL event sink (``log_event``-schema
     compatible) and a Prometheus text-format snapshot writer, both
     selectable via ``LANGDETECT_METRICS_SINK``.
+  * request tracing (:mod:`.tracing`) — a ``trace_id`` contextvar opened
+    per request (:func:`trace_request`) and stamped onto every span
+    record, plus a Chrome/Perfetto trace exporter CLI over any JSONL
+    capture.
+  * a flight recorder (:mod:`.flightrec`) — a bounded ring of recent
+    events that dumps a JSONL post-mortem when fit/score/stream raises;
+    gated by ``LANGDETECT_FLIGHT_RECORDER``.
+  * cost/roofline gauges (:mod:`.cost`) — XLA ``cost_analysis`` FLOPs and
+    bytes for the jitted score/fit programs, joined with measured span
+    timings into per-stage utilization estimates in ``stage_summary``.
   * ``python -m spark_languagedetector_tpu.telemetry.report <jsonl>`` — a
-    stage-tree summary CLI with percentiles (:mod:`.report`).
+    stage-tree summary CLI with percentiles (:mod:`.report`); its sibling
+    ``…telemetry.compare A.jsonl B.jsonl --threshold 0.25`` diffs two
+    captures per-stage and exits nonzero past threshold (:mod:`.compare`).
 
 Everything aggregates into one process-global :data:`REGISTRY`; sinks are
 attached from the environment on first import. Importing this package does
@@ -37,12 +49,15 @@ from .export import (
     render_prometheus,
     write_prometheus,
 )
+from .flightrec import FLIGHT_ENV
 from .gauges import install_jax_hooks, sample_device_gauges
 from .registry import REGISTRY, Histogram, Registry
 from .spans import FENCE_ENV, Span, current_span, span
+from .tracing import current_trace_id, new_trace_id, trace_request
 
 __all__ = [
     "FENCE_ENV",
+    "FLIGHT_ENV",
     "Histogram",
     "REGISTRY",
     "Registry",
@@ -50,10 +65,13 @@ __all__ = [
     "Span",
     "configure_sinks_from_env",
     "current_span",
+    "current_trace_id",
     "install_jax_hooks",
+    "new_trace_id",
     "render_prometheus",
     "sample_device_gauges",
     "span",
+    "trace_request",
     "write_prometheus",
 ]
 
@@ -70,6 +88,22 @@ except Exception as _e:
 
     _warnings.warn(
         f"{SINK_ENV} ignored — could not attach metric sinks: {_e}",
+        RuntimeWarning,
+        stacklevel=2,
+    )
+
+# The flight recorder is likewise env-armed at import (its ring only
+# buffers in memory; disk is touched solely on a crash dump), with the
+# same degrade-to-a-warning contract.
+try:
+    from .flightrec import install_from_env as _flightrec_install
+
+    _flightrec_install(REGISTRY)
+except Exception as _e:
+    import warnings as _warnings
+
+    _warnings.warn(
+        f"{FLIGHT_ENV} ignored — could not arm the flight recorder: {_e}",
         RuntimeWarning,
         stacklevel=2,
     )

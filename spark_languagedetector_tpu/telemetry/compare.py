@@ -1,0 +1,228 @@
+"""Regression guard: diff two telemetry captures per-stage, exit nonzero.
+
+    python -m spark_languagedetector_tpu.telemetry.compare \
+        baseline.jsonl candidate.jsonl [--threshold 0.25] \
+        [--metrics p50,p90,p99] [--min-seconds 0.0]
+
+Turns the bench trajectory into an enforceable contract: capture A is the
+accepted baseline (a BENCH_r* run's JSONL, a CI artifact), capture B is
+the candidate; for every span path present in both, the wall-time
+percentiles (and fenced device totals, and the snapshot-carried
+fill/waste/stall histograms) are compared, and any metric that moved past
+``--threshold`` (relative, in the *worse* direction — slower, less
+filled, more wasted) fails the run with exit code 1. Stages present in
+only one capture are reported but never fail the diff (instrumentation
+legitimately grows between rounds).
+
+Pure stdlib + this package's Histogram, like the report CLI — runs on the
+zero-accelerator CI host against checked-in fixtures.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .registry import Histogram
+from .report import load_events
+
+DEFAULT_THRESHOLD = 0.25
+# --metrics replaces this set wholesale: a user passing "--metrics p50"
+# has opted out of everything else, device metrics included.
+DEFAULT_METRICS = ("p50", "p90", "p99", "device_total_s", "device_p99")
+
+# Snapshot histograms where *higher* is better; everything else (stall
+# seconds, latency, padding waste, retries) regresses upward.
+_HIGHER_BETTER = ("fill_ratio",)
+
+
+def capture_stats(events: list[dict]) -> dict:
+    """One capture's comparable stats: per-stage wall/device aggregates +
+    the last snapshot's plain histograms."""
+    stages: dict[str, dict] = {}
+    wall: dict[str, Histogram] = {}
+    device: dict[str, Histogram] = {}
+    for ev in events:
+        if ev.get("event") != "telemetry.span":
+            continue
+        path, w = ev.get("path"), ev.get("wall_s")
+        if not isinstance(path, str) or not isinstance(w, (int, float)):
+            continue
+        wall.setdefault(path, Histogram()).record(float(w))
+        d = ev.get("device_s")
+        if isinstance(d, (int, float)):
+            device.setdefault(path, Histogram()).record(float(d))
+    for path, h in wall.items():
+        s = h.snapshot()
+        entry = {
+            "count": s["count"],
+            "total_s": s["sum"],
+            **{k: s[k] for k in ("mean", "p50", "p90", "p99") if k in s},
+        }
+        dh = device.get(path)
+        if dh is not None:
+            ds = dh.snapshot()
+            entry["device_total_s"] = ds["sum"]
+            if "p99" in ds:
+                entry["device_p99"] = ds["p99"]
+        stages[path] = entry
+
+    hists: dict[str, dict] = {}
+    for ev in events:
+        if ev.get("event") != "telemetry.snapshot":
+            continue
+        payload = ev.get("histograms")
+        if isinstance(payload, dict):
+            hists = {
+                str(k): v for k, v in payload.items()
+                if isinstance(v, dict) and v.get("count")
+            }
+    return {"stages": stages, "histograms": hists}
+
+
+def _rel_delta(base: float, new: float) -> float | None:
+    if not isinstance(base, (int, float)) or not isinstance(new, (int, float)):
+        return None
+    if base <= 0:
+        return None
+    return (new - base) / base
+
+
+def compare_captures(
+    base: dict,
+    new: dict,
+    *,
+    threshold: float = DEFAULT_THRESHOLD,
+    metrics: tuple[str, ...] = DEFAULT_METRICS,
+    min_seconds: float = 0.0,
+) -> tuple[list[str], list[str]]:
+    """(report lines, regression descriptions) for two capture_stats."""
+    lines: list[str] = []
+    regressions: list[str] = []
+    b_stages, n_stages = base["stages"], new["stages"]
+    shared = sorted(set(b_stages) & set(n_stages))
+    only_base = sorted(set(b_stages) - set(n_stages))
+    only_new = sorted(set(n_stages) - set(b_stages))
+
+    header = (
+        f"{'stage':<28} {'metric':<14} {'base':>12} {'new':>12} {'delta':>9}"
+    )
+    lines.append(header)
+    lines.append("-" * len(header))
+    span_metrics = tuple(metrics)
+    for path in shared:
+        b, n = b_stages[path], n_stages[path]
+        if b.get("total_s", 0.0) < min_seconds:
+            continue
+        for m in span_metrics:
+            if m not in b or m not in n:
+                continue
+            delta = _rel_delta(b[m], n[m])
+            if delta is None:
+                continue
+            flag = ""
+            if delta > threshold:
+                flag = "  REGRESSION"
+                regressions.append(
+                    f"{path} {m}: {b[m]:.6f} -> {n[m]:.6f} (+{delta:.1%})"
+                )
+            if flag or abs(delta) > threshold / 2:
+                lines.append(
+                    f"{path:<28} {m:<14} {b[m]:>12.6f} {n[m]:>12.6f} "
+                    f"{delta:>+8.1%}{flag}"
+                )
+
+    b_h, n_h = base["histograms"], new["histograms"]
+    for name in sorted(set(b_h) & set(n_h)):
+        b, n = b_h[name], n_h[name]
+        for m in ("mean", "p99"):
+            delta = _rel_delta(b.get(m), n.get(m))
+            if delta is None:
+                continue
+            higher_better = any(t in name for t in _HIGHER_BETTER)
+            worse = -delta if higher_better else delta
+            flag = ""
+            if worse > threshold:
+                flag = "  REGRESSION"
+                regressions.append(
+                    f"{name} {m}: {b[m]:.6f} -> {n[m]:.6f} ({delta:+.1%})"
+                )
+            if flag or abs(delta) > threshold / 2:
+                lines.append(
+                    f"{name:<28} {m:<14} {b[m]:>12.6f} {n[m]:>12.6f} "
+                    f"{delta:>+8.1%}{flag}"
+                )
+
+    if only_base:
+        lines.append(f"only in baseline: {', '.join(only_base)}")
+    if only_new:
+        lines.append(f"only in candidate: {', '.join(only_new)}")
+    return lines, regressions
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    threshold = DEFAULT_THRESHOLD
+    metrics = DEFAULT_METRICS
+    min_seconds = 0.0
+    paths: list[str] = []
+    i = 0
+    try:
+        while i < len(argv):
+            a = argv[i]
+            if a in ("-h", "--help"):
+                raise ValueError
+            if a == "--threshold":
+                threshold = float(argv[i + 1])
+                i += 2
+            elif a == "--metrics":
+                metrics = tuple(
+                    m.strip() for m in argv[i + 1].split(",") if m.strip()
+                )
+                i += 2
+            elif a == "--min-seconds":
+                min_seconds = float(argv[i + 1])
+                i += 2
+            elif a.startswith("-"):
+                raise ValueError(f"unknown option {a!r}")
+            else:
+                paths.append(a)
+                i += 1
+        if len(paths) != 2:
+            raise ValueError
+    except (ValueError, IndexError) as e:
+        msg = f"error: {e}\n" if str(e) else ""
+        print(
+            msg + "usage: python -m spark_languagedetector_tpu.telemetry."
+            "compare <baseline.jsonl> <candidate.jsonl> "
+            "[--threshold 0.25] [--metrics p50,p90,p99] [--min-seconds 0.0]",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        base = capture_stats(load_events(paths[0]))
+        new = capture_stats(load_events(paths[1]))
+    except OSError as e:
+        print(f"cannot read capture: {e}", file=sys.stderr)
+        return 2
+    if not base["stages"] and not base["histograms"]:
+        print(f"no comparable telemetry in {paths[0]}", file=sys.stderr)
+        return 2
+    lines, regressions = compare_captures(
+        base, new, threshold=threshold, metrics=metrics,
+        min_seconds=min_seconds,
+    )
+    print("\n".join(lines))
+    if regressions:
+        print(
+            f"\n{len(regressions)} regression(s) past threshold "
+            f"{threshold:.0%}:"
+        )
+        for r in regressions:
+            print(f"  {r}")
+        return 1
+    print(f"\nok: no regression past threshold {threshold:.0%}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,275 @@
+"""Cost/roofline gauges: compiled-program FLOPs/bytes vs measured time.
+
+The pjit/TPUv4 scaling workflow treats the hardware roofline as the tuning
+target: a stage is done when its achieved FLOP/s (or bytes/s) sits near
+the device peak, and a regression is diagnosed by which side of the
+roofline moved. This module supplies the static half of that ratio — XLA's
+own cost model for the jitted score/fit programs, via
+``jit(f).lower(shapes).compile().cost_analysis()`` (post-optimization
+numbers; the pre-compile ``Lowered.cost_analysis()`` is the fallback when
+backend compilation is not worth forcing, e.g. through a 20-40s remote
+TPU compile tunnel) — and records it as registry gauges:
+
+  * ``program_flops{program=<span path>}`` / ``program_bytes_accessed{...}``
+    — estimated cost of one call of the span at that path (the runner
+    records per-dispatch cost under ``score/dispatch``; the device fit
+    records per-step cost × steps under ``fit/count``);
+  * ``device_peak_flops{device=<platform>}`` /
+    ``device_peak_bytes_per_s{...}`` — roofline anchors per platform
+    (order-of-magnitude defaults; override with ``LANGDETECT_PEAK_FLOPS``
+    / ``LANGDETECT_PEAK_BYTES_PER_S`` for your exact part).
+
+:meth:`Registry.stage_summary` joins these gauges with the measured span
+timings into ``est_flops_per_s`` / ``flops_utilization`` /
+``bytes_utilization`` / ``roofline_bound`` per stage — surfaced in the
+bench's per-config ``telemetry`` block and (as gauges) in the Prometheus
+renderer. Utilization is computed against fenced ``device_*`` timings
+when available and wall time otherwise; without
+``LANGDETECT_TELEMETRY_FENCE=1`` the wall number is *enqueue* time for
+async dispatches, so treat unfenced utilization as an upper bound.
+
+Everything here is diagnostics: every entry point is exception-contained
+and returns None rather than disturb the computation it measures.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .registry import REGISTRY, Registry
+
+PEAK_FLOPS_ENV = "LANGDETECT_PEAK_FLOPS"
+PEAK_BYTES_ENV = "LANGDETECT_PEAK_BYTES_PER_S"
+
+# Order-of-magnitude roofline anchors per platform: (flops/s, bytes/s).
+# TPU: v4 bf16 MXU peak + HBM2 bandwidth (the paper's target part); GPU:
+# A100-class; CPU: a nominal host anchor so utilization stays defined (and
+# obviously approximate) on the zero-accelerator CI substrate.
+_PLATFORM_PEAKS: dict[str, tuple[float, float]] = {
+    "tpu": (275e12, 1.2e12),
+    "gpu": (312e12, 2.0e12),
+    "cpu": (1.0e11, 5.0e10),
+}
+
+# Guard for forcing a backend compile purely for cost numbers: tiny next
+# to a real compile, but unbounded programs (a 16.8M-row scatter table)
+# should settle for the pre-compile analysis.
+_COMPILE_FOR_COST_MAX_ELEMS = 1 << 24
+
+
+def peak_rates(platform: str, env=os.environ) -> tuple[float, float] | None:
+    """(peak flops/s, peak bytes/s) for a platform; env vars override."""
+    base = _PLATFORM_PEAKS.get(platform)
+    try:
+        flops = float(env.get(PEAK_FLOPS_ENV, "") or 0) or None
+        byts = float(env.get(PEAK_BYTES_ENV, "") or 0) or None
+    except ValueError:
+        flops = byts = None
+    if base is None and flops is None and byts is None:
+        return None
+    return (
+        flops if flops is not None else (base[0] if base else 0.0),
+        byts if byts is not None else (base[1] if base else 0.0),
+    )
+
+
+def normalize_cost(analysis) -> dict | None:
+    """XLA cost_analysis output (dict, or list-of-dict from ``Compiled``)
+    → ``{"flops": float, "bytes_accessed": float}`` (keys present only
+    when the backend reported them)."""
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else None
+    if not isinstance(analysis, dict):
+        return None
+    out: dict = {}
+    flops = analysis.get("flops")
+    if isinstance(flops, (int, float)) and flops >= 0:
+        out["flops"] = float(flops)
+    byts = analysis.get("bytes accessed")
+    if isinstance(byts, (int, float)) and byts >= 0:
+        out["bytes_accessed"] = float(byts)
+    return out or None
+
+
+def program_cost(fn, *args, prefer_compiled: bool | None = None) -> dict | None:
+    """Cost of ``jit(fn)`` at the given (abstract) operand shapes.
+
+    ``args`` are ``jax.ShapeDtypeStruct``s (or concrete arrays) — lowering
+    never executes the program. ``prefer_compiled=None`` forces the
+    backend compile only on CPU, where it is cheap and its post-layout
+    numbers beat the pre-compile estimate; elsewhere (or when compile
+    fails) the ``Lowered`` analysis is used.
+    """
+    try:
+        import jax
+
+        lowered = jax.jit(fn).lower(*args)
+    except Exception:
+        return None
+    if prefer_compiled is None:
+        try:
+            prefer_compiled = jax.default_backend() == "cpu"
+        except Exception:
+            prefer_compiled = False
+    if prefer_compiled:
+        try:
+            cost = normalize_cost(lowered.compile().cost_analysis())
+            if cost:
+                return cost
+        except Exception:
+            pass
+    try:
+        return normalize_cost(lowered.cost_analysis())
+    except Exception:
+        return None
+
+
+def record_program_cost(
+    program: str,
+    cost: dict | None,
+    *,
+    calls: float = 1.0,
+    platform: str | None = None,
+    registry: Registry | None = None,
+) -> None:
+    """Record one program's cost gauges (scaled to per-span-call units).
+
+    ``calls`` is the number of compiled-program executions one span at
+    ``program``'s path covers (1 for per-dispatch spans; the fit count
+    loop's step count for its whole-loop span), so stage_summary's join
+    of gauge × span timing stays dimensionally honest.
+    """
+    if not cost:
+        return
+    reg = registry if registry is not None else REGISTRY
+    if "flops" in cost:
+        reg.set_gauge("program_flops", cost["flops"] * calls, program=program)
+    if "bytes_accessed" in cost:
+        reg.set_gauge(
+            "program_bytes_accessed", cost["bytes_accessed"] * calls,
+            program=program,
+        )
+    if platform:
+        peaks = peak_rates(platform)
+        if peaks:
+            reg.set_gauge("device_peak_flops", peaks[0], device=platform)
+            reg.set_gauge("device_peak_bytes_per_s", peaks[1], device=platform)
+
+
+def record_runner_cost(
+    runner, rows: int, pad_to: int, registry: Registry | None = None
+) -> dict | None:
+    """Cost of one of ``runner``'s score dispatches at [rows, pad_to].
+
+    Lowers the runner's own dispatch function (whatever strategy it
+    resolved) over abstract operands and records it under
+    ``program_flops{program="score/dispatch"}`` — the span path whose
+    count matches one dispatch per call. Mesh runners are skipped: the
+    GSPMD program's analysis is per-process, not per-chip, and would
+    misstate utilization.
+
+    Approximation note: the modeled program is the *padded* [rows,
+    pad_to] dispatch. Ragged-transfer runners actually run device-side
+    unpack + the same scoring math, so flops match but ``bytes_accessed``
+    is the padded upper bound (and no variant models the h2d wire —
+    cost_analysis is program-side memory traffic, not transfer bytes).
+    """
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        if runner.mesh is not None:
+            return None
+        batch = jax.ShapeDtypeStruct((int(rows), int(pad_to)), jnp.uint8)
+        lengths = jax.ShapeDtypeStruct((int(rows),), jnp.int32)
+        platform = runner._target_device().platform
+        cost = program_cost(
+            lambda b, l: runner._dispatch_device(b, l, None, None),
+            batch,
+            lengths,
+            prefer_compiled=(platform == "cpu"),
+        )
+        record_program_cost(
+            "score/dispatch", cost, platform=platform, registry=registry
+        )
+        return cost
+    except Exception:
+        return None
+
+
+# Most frequent step shapes analyzed per fit; a pathological fit (many
+# distinct oversized-doc widths) bills the remainder by scaling rather
+# than lowering dozens of programs for a diagnostic gauge.
+_FIT_COST_MAX_SHAPES = 12
+
+
+def record_fit_count_cost(
+    spec,
+    num_langs: int,
+    step_shapes: dict,
+    registry: Registry | None = None,
+) -> dict | None:
+    """Cost of the device fit's count loop, recorded under
+    ``program="fit/count"`` (that span wraps the whole loop, so per-call
+    units are whole-loop units).
+
+    ``step_shapes`` maps each dispatched ``(rows, pad_to)`` to its step
+    count — the loop's actual compiled-shape set. Each distinct shape's
+    program is analyzed and the costs summed, so small/tail/narrow-bucket
+    steps are billed at their own size, not the largest shape's.
+    """
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.fit_tpu import fit_dense_step
+
+        shapes = [
+            ((int(r), int(p)), int(n))
+            for (r, p), n in step_shapes.items()
+            if n > 0 and r > 0 and p > 0
+        ]
+        if not shapes:
+            return None
+        shapes.sort(key=lambda it: -it[1])
+        covered = shapes[:_FIT_COST_MAX_SHAPES]
+        V = spec.id_space_size
+        platform = jax.devices()[0].platform
+        prefer = (
+            platform == "cpu"
+            and V * num_langs <= _COMPILE_FOR_COST_MAX_ELEMS
+        )
+        acc = jax.ShapeDtypeStruct((V, num_langs), jnp.int32)
+        total: dict = {}
+        covered_steps = 0
+        for (rows, pad_to), n in covered:
+            cost = program_cost(
+                lambda b, l, g, a: fit_dense_step(
+                    b, l, g, a, spec=spec, num_langs=num_langs
+                ),
+                jax.ShapeDtypeStruct((rows, pad_to), jnp.uint8),
+                jax.ShapeDtypeStruct((rows,), jnp.int32),
+                jax.ShapeDtypeStruct((rows,), jnp.int32),
+                acc,
+                prefer_compiled=prefer,
+            )
+            if not cost:
+                continue
+            covered_steps += n
+            for k, v in cost.items():
+                total[k] = total.get(k, 0.0) + v * n
+        if not total or not covered_steps:
+            return None
+        # Steps not billed directly (shapes past the cap, or whose
+        # analysis failed): bill at the billed shapes' per-step average.
+        total_steps = sum(n for _, n in shapes)
+        if total_steps > covered_steps:
+            total = {
+                k: v * (total_steps / covered_steps) for k, v in total.items()
+            }
+        record_program_cost(
+            "fit/count", total, platform=platform, registry=registry
+        )
+        return total
+    except Exception:
+        return None

@@ -258,7 +258,47 @@ class Registry:
                         for k in ("mean", "p50", "p99") if k in ds
                     })
                 out[path] = entry
+            self._attach_cost_estimates(out)
             return out
+
+    def _attach_cost_estimates(self, out: dict[str, dict]) -> None:
+        """Join cost gauges (telemetry.cost) onto matching stage entries.
+
+        A ``program_flops{program=<path>}`` gauge holds the XLA-estimated
+        FLOPs of one call of the span at ``<path>``; divided by the
+        measured per-call seconds (fenced ``device_mean`` preferred — the
+        wall mean of an async dispatch is enqueue time) it yields achieved
+        FLOP/s, and against the ``device_peak_*`` roofline anchors a
+        utilization fraction. Caller holds the lock.
+        """
+        peak_f = next(
+            iter(self.gauges.get("device_peak_flops", {}).values()), None
+        )
+        peak_b = next(
+            iter(self.gauges.get("device_peak_bytes_per_s", {}).values()), None
+        )
+        for gauge, unit, peak in (
+            ("program_flops", "flops", peak_f),
+            ("program_bytes_accessed", "bytes", peak_b),
+        ):
+            for key, per_call in self.gauges.get(gauge, {}).items():
+                path = dict(key).get("program")
+                entry = out.get(path)
+                if entry is None:
+                    continue
+                entry[f"est_{unit}_per_call"] = round(per_call, 3)
+                seconds = entry.get("device_mean", entry.get("mean"))
+                if not seconds:
+                    continue
+                rate = per_call / seconds
+                entry[f"est_{unit}_per_s"] = round(rate, 3)
+                if peak:
+                    entry[f"{unit}_utilization"] = round(rate / peak, 6)
+        for entry in out.values():
+            fu = entry.get("flops_utilization")
+            bu = entry.get("bytes_utilization")
+            if fu is not None and bu is not None:
+                entry["roofline_bound"] = "compute" if fu >= bu else "memory"
 
     def flush(self) -> None:
         """Emit a snapshot event to the per-event sinks and refresh every

@@ -104,34 +104,54 @@ def render_report(events: list[dict]) -> str:
 
     snapshots = [e for e in events if e.get("event") == "telemetry.snapshot"]
     if snapshots:
+        # Defensive rendering throughout: a capture may be hand-edited,
+        # truncated mid-object, or emitted by a newer schema — a malformed
+        # snapshot section must degrade to "skip that entry", never to a
+        # report-killing TypeError (the report is most needed exactly when
+        # the run that produced the file went wrong).
         last = snapshots[-1]
-        hists = last.get("histograms") or {}
-        if hists:
-            lines.append("")
-            lines.append("histograms (last snapshot):")
-            for name, h in sorted(hists.items()):
+        hists = last.get("histograms")
+        if isinstance(hists, dict) and hists:
+            rendered = []
+            for name in sorted(hists, key=str):
+                h = hists[name]
                 if not isinstance(h, dict) or not h.get("count"):
                     continue
-                lines.append(
-                    f"  {name:<32} n={h['count']:<7} "
-                    f"mean={h.get('mean', 0.0):.5f} "
-                    f"p50={h.get('p50', 0.0):.5f} "
-                    f"p99={h.get('p99', 0.0):.5f}"
-                )
-        counters = last.get("counters") or {}
-        if counters:
+                try:
+                    rendered.append(
+                        f"  {str(name):<32} n={h['count']:<7} "
+                        f"mean={float(h.get('mean', 0.0)):.5f} "
+                        f"p50={float(h.get('p50', 0.0)):.5f} "
+                        f"p99={float(h.get('p99', 0.0)):.5f}"
+                    )
+                except (TypeError, ValueError):
+                    continue
+            if rendered:
+                lines.append("")
+                lines.append("histograms (last snapshot):")
+                lines.extend(rendered)
+        counters = last.get("counters")
+        if isinstance(counters, dict) and counters:
             lines.append("")
             lines.append("counters (last snapshot):")
-            for name, value in sorted(counters.items()):
-                lines.append(f"  {name:<40} {value}")
-        gauges = last.get("gauges") or {}
-        if gauges:
-            lines.append("")
-            lines.append("gauges (last snapshot):")
-            for name, series in sorted(gauges.items()):
-                for labels, value in sorted(series.items()):
-                    tag = f"{name}{{{labels}}}" if labels else name
-                    lines.append(f"  {tag:<40} {value}")
+            for name in sorted(counters, key=str):
+                lines.append(f"  {str(name):<40} {counters[name]}")
+        gauges = last.get("gauges")
+        if isinstance(gauges, dict) and gauges:
+            rendered = []
+            for name in sorted(gauges, key=str):
+                series = gauges[name]
+                if not isinstance(series, dict):
+                    continue
+                for labels in sorted(series, key=str):
+                    tag = f"{name}{{{labels}}}" if labels else str(name)
+                    rendered.append(f"  {tag:<40} {series[labels]}")
+            if rendered:
+                lines.append("")
+                lines.append("gauges (last snapshot):")
+                lines.extend(rendered)
+    if not events:
+        return "empty capture: no telemetry events"
     return "\n".join(lines)
 
 

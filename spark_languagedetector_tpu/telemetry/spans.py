@@ -28,10 +28,12 @@ from __future__ import annotations
 
 import contextvars
 import os
+import threading
 import time
 from contextlib import contextmanager
 
 from .registry import REGISTRY, Registry
+from .tracing import current_trace_id
 
 FENCE_ENV = "LANGDETECT_TELEMETRY_FENCE"
 
@@ -54,13 +56,20 @@ def current_span() -> "Span | None":
 class Span:
     """One open timing region. Created by :func:`span`, not directly."""
 
-    __slots__ = ("name", "path", "parent", "attrs", "_fences")
+    __slots__ = ("name", "path", "parent", "attrs", "trace_id", "_fences")
 
     def __init__(self, name: str, path: str, parent: "Span | None", attrs: dict):
         self.name = name
         self.path = path
         self.parent = parent
         self.attrs = attrs
+        # Request attribution: the ambient trace context wins (a stream
+        # batch's per-request scope overrides the engine root's), the
+        # explicit parent's id is the cross-thread fallback (worker
+        # threads have no ambient context of their own).
+        self.trace_id = current_trace_id() or (
+            parent.trace_id if parent is not None else None
+        )
         self._fences: list = []
 
     def fence(self, *arrays) -> None:
@@ -130,4 +139,10 @@ def span(
                         pass  # fencing must never mask the real error path
             device_s = time.perf_counter() - t0
         _ACTIVE.reset(token)
+        # Stamped at exit so the exported record carries the request id and
+        # the recording thread (the Chrome-trace exporter's lane key);
+        # explicit attrs of the same name win.
+        if sp.trace_id is not None:
+            sp.attrs.setdefault("trace_id", sp.trace_id)
+        sp.attrs.setdefault("tid", threading.get_ident())
         reg.record_span(sp.path, wall_s, device_s, sp.attrs)

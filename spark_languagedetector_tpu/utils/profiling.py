@@ -9,12 +9,20 @@ TensorBoard/XProf without touching call sites:
         model.transform(table)
 
 or environment-driven (no code change): set ``LANGDETECT_TRACE_DIR`` and
-every ``BatchRunner.score`` call traces itself.
+every ``BatchRunner.score`` call traces itself. Call sites that pass a
+``label`` get a per-call subdirectory (``score-0000/``, ``score-0001/``,
+...) under the target, so repeated captures never clobber one another's
+XProf dumps. Each active capture also records a ``profile/trace``
+telemetry span, so profiler runs show up in stage trees alongside the
+stages they were profiling.
 """
 
 from __future__ import annotations
 
+import itertools
 import os
+import threading
+import time
 from contextlib import contextmanager
 
 from .logging import get_logger, log_event
@@ -23,21 +31,52 @@ _log = get_logger("utils.profiling")
 
 TRACE_DIR_ENV = "LANGDETECT_TRACE_DIR"
 
+# Process-wide capture sequence: labeled captures land in distinct
+# subdirectories even across threads and call sites.
+_TRACE_SEQ = itertools.count()
+
 
 @contextmanager
-def trace(log_dir: str | None = None):
+def trace(log_dir: str | None = None, label: str | None = None):
     """Profile the enclosed region to ``log_dir`` (or $LANGDETECT_TRACE_DIR).
 
     No-op when neither is set, so production call sites can wrap hot regions
-    unconditionally.
+    unconditionally. ``label`` appends a per-call ``<label>-<seq>/``
+    subdirectory so repeated captures keep their dumps apart. The
+    ``trace_done`` event is emitted via try/finally — an exception in the
+    traced region still marks the capture finished (and the telemetry span
+    still records), instead of silently swallowing the event.
     """
     log_dir = log_dir or os.environ.get(TRACE_DIR_ENV)
     if not log_dir:
         yield
         return
+    if label:
+        log_dir = os.path.join(log_dir, f"{label}-{next(_TRACE_SEQ):04d}")
     import jax
 
-    with jax.profiler.trace(log_dir):
-        log_event(_log, "profiling.trace_start", dir=log_dir)
-        yield
-    log_event(_log, "profiling.trace_done", dir=log_dir)
+    from ..telemetry import REGISTRY, current_trace_id
+
+    t0 = time.perf_counter()
+    try:
+        with jax.profiler.trace(log_dir):
+            log_event(_log, "profiling.trace_start", dir=log_dir)
+            yield
+    finally:
+        # Recorded directly (not via the span() context manager): an
+        # ambient profile/trace span would become the parent of every
+        # stage span in the traced region and silently re-root the whole
+        # tree (profile/trace/score/...), breaking the cost-gauge join
+        # and cross-capture stage matching. Direct recording yields the
+        # same root-level stage entry without touching the nesting.
+        attrs = {"dir": log_dir, "tid": threading.get_ident()}
+        tid = current_trace_id()
+        if tid is not None:
+            attrs["trace_id"] = tid
+        try:
+            REGISTRY.record_span(
+                "profile/trace", time.perf_counter() - t0, None, attrs
+            )
+        except Exception:
+            pass  # diagnostics never mask the traced region's error
+        log_event(_log, "profiling.trace_done", dir=log_dir)

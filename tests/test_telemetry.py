@@ -742,3 +742,738 @@ def test_split_fit_records_host_half_and_merge():
     # Both halves land under fit/count: the device scatter-add loop and
     # the host long-gram sweep.
     assert stages["fit/count"]["count"] >= 2, stages["fit/count"]
+
+
+# ---------------------------------------------------------- request tracing --
+class _ListSink:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, event):
+        self.events.append(event)
+
+
+def test_trace_request_mints_reuses_and_rebinds():
+    from spark_languagedetector_tpu.telemetry import (
+        current_trace_id,
+        trace_request,
+    )
+
+    assert current_trace_id() is None
+    with trace_request() as outer:
+        assert current_trace_id() == outer
+        # Default: an ambient request is reused, not shadowed.
+        with trace_request() as inner:
+            assert inner == outer
+        # Explicit id: rebinds (the stream engine's per-batch scopes).
+        with trace_request("feedface00000001") as forced:
+            assert forced == "feedface00000001"
+            assert current_trace_id() == forced
+        assert current_trace_id() == outer
+    assert current_trace_id() is None
+
+
+def test_span_stamps_trace_id_and_tid():
+    from spark_languagedetector_tpu.telemetry import trace_request
+
+    reg = Registry()
+    sink = _ListSink()
+    reg.add_sink(sink)
+    with trace_request("cafe000000000001"):
+        with span("score", registry=reg):
+            pass
+    with span("untraced", registry=reg):
+        pass
+    traced, untraced = sink.events
+    assert traced["trace_id"] == "cafe000000000001"
+    assert isinstance(traced["tid"], int)
+    assert "trace_id" not in untraced  # no ambient request, no stamp
+    assert isinstance(untraced["tid"], int)
+
+
+def test_trace_id_inherits_through_explicit_parent_across_threads():
+    """Worker threads have no ambient trace context; the explicit span
+    parent must carry the request id across — the runner's dispatch
+    workers and the stream prefetch workers rely on this."""
+    from spark_languagedetector_tpu.telemetry import trace_request
+
+    reg = Registry()
+    sink = _ListSink()
+    reg.add_sink(sink)
+    with trace_request("beef000000000001"):
+        with span("score", registry=reg) as root:
+            def worker():
+                with span("score/dispatch", parent=root, registry=reg):
+                    pass
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+    by_path = {e["path"]: e for e in sink.events}
+    assert by_path["score/dispatch"]["trace_id"] == "beef000000000001"
+    assert by_path["score"]["trace_id"] == "beef000000000001"
+
+
+def test_ambient_trace_wins_over_parent_trace():
+    """A per-batch request scope set on a worker thread must override the
+    parent span's (stream-root) trace — that is how one stream batch gets
+    its own id while still nesting under the stream tree."""
+    from spark_languagedetector_tpu.telemetry import trace_request
+
+    reg = Registry()
+    sink = _ListSink()
+    reg.add_sink(sink)
+    with trace_request("00000000000000aa"):
+        with span("stream", registry=reg) as root:
+            with trace_request("00000000000000bb"):
+                with span("stream/transform", parent=root, registry=reg):
+                    pass
+    by_path = {e["path"]: e for e in sink.events}
+    assert by_path["stream/transform"]["trace_id"] == "00000000000000bb"
+    assert by_path["stream"]["trace_id"] == "00000000000000aa"
+
+
+def test_runner_score_call_shares_one_trace_id():
+    from spark_languagedetector_tpu import LanguageDetectorModel, Table
+    from spark_languagedetector_tpu.telemetry import REGISTRY
+
+    REGISTRY.reset()
+    sink = _ListSink()
+    REGISTRY.add_sink(sink)
+    try:
+        model = LanguageDetectorModel.from_gram_map(
+            {b"ab": [1.0, 0.0], b"xy": [0.0, 1.0]}, [2], ["a", "x"]
+        )
+        model.transform(Table({"fulltext": ["ababab", "xyxy"] * 10}))
+        model.transform(Table({"fulltext": ["ababab"] * 5}))
+    finally:
+        REGISTRY.remove_sink(sink)
+    score_roots = [e for e in sink.events if e.get("path") == "score"]
+    assert len(score_roots) == 2
+    ids = [e.get("trace_id") for e in score_roots]
+    assert all(ids) and ids[0] != ids[1]  # one fresh request per call
+    # Every sub-span of a call carries its call's id.
+    for e in sink.events:
+        if str(e.get("path", "")).startswith("score/"):
+            assert e.get("trace_id") in ids
+
+
+def test_stream_batches_get_distinct_trace_ids():
+    from spark_languagedetector_tpu import LanguageDetectorModel, Table
+    from spark_languagedetector_tpu.stream.microbatch import (
+        memory_source,
+        run_stream,
+    )
+    from spark_languagedetector_tpu.telemetry import REGISTRY
+
+    REGISTRY.reset()
+    sink = _ListSink()
+    REGISTRY.add_sink(sink)
+    try:
+        model = LanguageDetectorModel.from_gram_map(
+            {b"ab": [1.0, 0.0], b"xy": [0.0, 1.0]}, [2], ["a", "x"]
+        )
+        rows = [{"fulltext": "ababab"}] * 30
+        q = run_stream(
+            model, memory_source(rows, 10), lambda t: None,
+            prefetch=2, workers=2,
+        )
+    finally:
+        REGISTRY.remove_sink(sink)
+    assert q.batches == 3
+    batch_ids = {
+        e["trace_id"] for e in sink.events if e.get("path") == "stream/batch"
+    }
+    transform_ids = {
+        e["trace_id"]
+        for e in sink.events
+        if e.get("path") == "stream/transform"
+    }
+    assert len(batch_ids) == 3 and batch_ids == transform_ids
+    assert q.last_batch_trace_id in batch_ids
+    # The nested runner spans join their batch's request, not a new one.
+    inner = {
+        e.get("trace_id")
+        for e in sink.events
+        if str(e.get("path", "")).startswith("stream/transform/score")
+    }
+    assert inner and inner <= batch_ids
+
+
+# ------------------------------------------------------- chrome trace export --
+def _valid_chrome_trace(trace: dict) -> list[dict]:
+    """Assert trace-event JSON validity; returns the complete ('X') events."""
+    assert json.loads(json.dumps(trace)) == trace  # JSON-serializable
+    events = trace["traceEvents"]
+    assert isinstance(events, list)
+    complete = [e for e in events if e.get("ph") == "X"]
+    for e in complete:
+        assert isinstance(e["name"], str)
+        assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+        assert isinstance(e["tid"], int) and isinstance(e["pid"], int)
+    lanes = {}
+    for e in complete:
+        lanes.setdefault(e["tid"], []).append(e["ts"])
+    for tss in lanes.values():
+        assert tss == sorted(tss), "per-lane ts must be monotonic"
+    return complete
+
+
+def test_chrome_trace_from_fixture_is_valid_and_carries_trace_ids():
+    from spark_languagedetector_tpu.telemetry.tracing import (
+        render_chrome_trace,
+    )
+
+    fixture_regressed = os.path.join(
+        os.path.dirname(__file__), "fixtures",
+        "telemetry_fixture_regressed.jsonl",
+    )
+    events = load_events(fixture_regressed)
+    trace = render_chrome_trace(events)
+    complete = _valid_chrome_trace(trace)
+    names = {e["name"] for e in complete}
+    assert "score/dispatch" in names and "fit/count" in names
+    # Fenced spans get a device lane alongside the host lane.
+    assert "score/dispatch [device]" in names
+    tids = {
+        e["args"].get("trace_id") for e in complete
+        if e["name"].startswith("score")
+    }
+    assert "deadbeef00000001" in tids
+    # Gauge snapshots ride as counter events.
+    assert any(e.get("ph") == "C" for e in trace["traceEvents"])
+
+
+def test_chrome_trace_cli_round_trip(tmp_path, capsys):
+    from spark_languagedetector_tpu.telemetry.tracing import main as t_main
+
+    out = str(tmp_path / "fixture.trace.json")
+    assert t_main([FIXTURE, out]) == 0
+    assert capsys.readouterr().out.strip() == out
+    with open(out) as fh:
+        _valid_chrome_trace(json.load(fh))
+    assert t_main([]) == 2
+    assert t_main([str(tmp_path / "missing.jsonl"), out]) == 2
+
+
+def test_chrome_trace_interleaved_threads_stay_monotonic_per_lane():
+    """Events landing out of start-order across threads (the JSONL file is
+    ordered by *end* time) must still export with per-lane monotonic ts."""
+    from spark_languagedetector_tpu.telemetry.tracing import (
+        render_chrome_trace,
+    )
+
+    events = [
+        {"event": "telemetry.span", "ts": 10.0, "path": "a", "wall_s": 9.0,
+         "tid": 1},
+        {"event": "telemetry.span", "ts": 10.5, "path": "b", "wall_s": 0.2,
+         "tid": 2},
+        {"event": "telemetry.span", "ts": 11.0, "path": "c", "wall_s": 10.0,
+         "tid": 2},  # started BEFORE b on the same lane
+        {"event": "telemetry.span", "ts": 12.0, "path": "d", "wall_s": 0.1,
+         "tid": 1},
+    ]
+    _valid_chrome_trace(render_chrome_trace(events))
+
+
+def test_chrome_trace_remaps_real_thread_idents_to_small_lanes():
+    """Thread idents are pthread addresses on Linux (~1e14): lanes must be
+    dense ordinals — a raw ident as a lane id would label every host lane
+    as a device lane, and masking one could collide two threads."""
+    from spark_languagedetector_tpu.telemetry.tracing import (
+        render_chrome_trace,
+    )
+
+    big_a, big_b = 139272512337664, 139272512337664 + (1 << 16)  # same low bits
+    events = [
+        {"event": "telemetry.span", "ts": 1.0, "path": "a", "wall_s": 0.1,
+         "tid": big_a, "device_s": 0.2},
+        {"event": "telemetry.span", "ts": 1.1, "path": "b", "wall_s": 0.1,
+         "tid": big_b, "device_s": 0.2},
+    ]
+    trace = render_chrome_trace(events)
+    meta = {
+        e["tid"]: e["args"]["name"]
+        for e in trace["traceEvents"]
+        if e.get("ph") == "M" and e["name"] == "thread_name"
+    }
+    host = {t: n for t, n in meta.items() if n.startswith("thread ")}
+    device = {t: n for t, n in meta.items() if n.startswith("device")}
+    assert len(host) == 2 and len(device) == 2  # no lane collision
+    assert all(t < (1 << 21) for t in meta)
+    assert str(big_a) in " ".join(meta.values())  # ident kept in the label
+
+
+def test_chrome_trace_empty_and_garbage_events():
+    from spark_languagedetector_tpu.telemetry.tracing import (
+        render_chrome_trace,
+    )
+
+    assert render_chrome_trace([])["traceEvents"]  # metadata only, valid
+    trace = render_chrome_trace([
+        {"event": "telemetry.span"},  # no path/wall
+        {"event": "telemetry.span", "path": "x", "wall_s": "bogus"},
+        {"not": "an event"},
+    ])
+    assert not [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+
+
+# ----------------------------------------------------------- flight recorder --
+@pytest.fixture
+def flight(tmp_path):
+    from spark_languagedetector_tpu.telemetry import flightrec
+
+    flightrec.uninstall()  # isolate from any env-armed recorder
+    rec = flightrec.install(str(tmp_path / "fr"))
+    yield rec
+    flightrec.uninstall()
+
+
+def test_flight_recorder_ring_is_bounded_and_dump_has_recent_events(tmp_path):
+    from spark_languagedetector_tpu.telemetry.flightrec import FlightRecorder
+
+    rec = FlightRecorder(str(tmp_path / "fr"), capacity=10)
+    for i in range(25):
+        rec.emit({"event": "telemetry.span", "path": "s", "i": i})
+    assert len(rec) == 10
+    path = rec.dump(context="score", error="ValueError('x')")
+    lines = [json.loads(l) for l in open(path)]
+    header, body = lines[0], lines[1:]
+    assert header["event"] == "flightrec.dump"
+    assert header["context"] == "score" and "ValueError" in header["error"]
+    assert header["events"] == 10
+    assert [e["i"] for e in body] == list(range(15, 25))  # most recent kept
+    # A second dump gets its own file.
+    assert rec.dump(context="score") != path
+
+
+def test_flight_recorder_env_install(tmp_path):
+    from spark_languagedetector_tpu.telemetry import flightrec
+
+    flightrec.uninstall()
+    try:
+        assert flightrec.install_from_env(env={}) is None
+        assert flightrec.install_from_env(
+            env={"LANGDETECT_FLIGHT_RECORDER": "0"}
+        ) is None
+        rec = flightrec.install_from_env(env={
+            "LANGDETECT_FLIGHT_RECORDER": str(tmp_path / "fr"),
+            "LANGDETECT_FLIGHT_RECORDER_EVENTS": "7",
+        })
+        assert rec is not None and rec._ring.maxlen == 7
+        assert flightrec.active() is rec
+        # Idempotent: a second install returns the same recorder.
+        assert flightrec.install_from_env(env={
+            "LANGDETECT_FLIGHT_RECORDER": "1"
+        }) is rec
+    finally:
+        flightrec.uninstall()
+
+
+def test_runner_crash_dumps_flight_ring(flight):
+    """A raising score call must leave a post-mortem with the spans that
+    led up to it — the tentpole's crash contract, driven through the real
+    BatchRunner entry point."""
+    from spark_languagedetector_tpu import LanguageDetectorModel, Table
+    from spark_languagedetector_tpu.telemetry import REGISTRY, flightrec
+
+    REGISTRY.reset()
+    model = LanguageDetectorModel.from_gram_map(
+        {b"ab": [1.0, 0.0], b"xy": [0.0, 1.0]}, [2], ["a", "x"]
+    )
+    model.transform(Table({"fulltext": ["abab"] * 4}))  # ring gets context
+    runner = model._get_runner()
+    # A programming error mid-batch (not RETRYABLE): propagates at once.
+    runner._pack = staticmethod(
+        lambda docs, pad_to: (_ for _ in ()).throw(ValueError("bad pack"))
+    )
+    with pytest.raises(ValueError):
+        runner.score([b"abab"])
+    dump = flightrec.last_dump_path()
+    assert dump is not None and os.path.exists(dump)
+    lines = [json.loads(l) for l in open(dump)]
+    assert lines[0]["context"] == "score"
+    assert any(e.get("path") == "score" for e in lines[1:])
+
+
+def test_stream_crash_dumps_once_for_nested_failure(flight):
+    from spark_languagedetector_tpu import LanguageDetectorModel, Table
+    from spark_languagedetector_tpu.stream.microbatch import (
+        memory_source,
+        run_stream,
+    )
+    from spark_languagedetector_tpu.telemetry import REGISTRY, flightrec
+
+    REGISTRY.reset()
+    model = LanguageDetectorModel.from_gram_map(
+        {b"ab": [1.0, 0.0], b"xy": [0.0, 1.0]}, [2], ["a", "x"]
+    )
+
+    def dying_sink(table):
+        raise OSError("sink full")
+
+    with pytest.raises(OSError):
+        run_stream(
+            model,
+            memory_source([{"fulltext": "abab"}] * 20, 10),
+            dying_sink,
+        )
+    dump = flightrec.last_dump_path()
+    assert dump is not None
+    assert json.loads(open(dump).readline())["context"] == "stream"
+    assert REGISTRY.counters.get("telemetry/flightrec_dumps") == 1
+
+
+def test_record_crash_dedups_per_object_not_per_address(flight):
+    """The same exception unwinding through nested hooks dumps once; a
+    later distinct exception — even one whose object reuses the freed
+    address, CPython's common case — must still dump."""
+    from spark_languagedetector_tpu.telemetry import flightrec
+
+    e1 = RuntimeError("first")
+    p1 = flightrec.record_crash("score", e1)
+    assert p1 is not None
+    assert flightrec.record_crash("stream", e1) is None  # nested hook
+    del e1  # free the address
+    p2 = flightrec.record_crash("score", RuntimeError("second"))
+    assert p2 is not None and p2 != p1
+
+
+def test_record_crash_is_contained_and_counts_failures(tmp_path):
+    from spark_languagedetector_tpu.telemetry import flightrec
+
+    flightrec.uninstall()
+    # No recorder armed: a no-op, not an error.
+    assert flightrec.record_crash("score", ValueError("x")) is None
+    blocker = tmp_path / "blocker"
+    blocker.write_text("")  # a *file*, so dumps into it must fail
+    reg = Registry()
+    flightrec.install(str(blocker / "sub"), registry=reg)
+    try:
+        import warnings
+
+        with warnings.catch_warnings(record=True):
+            warnings.simplefilter("always")
+            assert flightrec.record_crash(
+                "score", ValueError("x"), registry=reg
+            ) is None
+        assert reg.counters["telemetry/flightrec_errors"] >= 1
+    finally:
+        flightrec.uninstall(registry=reg)
+
+
+# ------------------------------------------------------ cost/roofline gauges --
+def test_program_cost_on_abstract_shapes():
+    import jax
+    import jax.numpy as jnp
+
+    from spark_languagedetector_tpu.telemetry.cost import program_cost
+
+    cost = program_cost(
+        lambda x, w: jnp.dot(x, w),
+        jax.ShapeDtypeStruct((128, 64), jnp.float32),
+        jax.ShapeDtypeStruct((64, 32), jnp.float32),
+    )
+    assert cost is not None
+    # dot flops = 2 * M * K * N
+    assert cost["flops"] == pytest.approx(2 * 128 * 64 * 32, rel=0.01)
+    assert cost.get("bytes_accessed", 1) > 0
+
+
+def test_normalize_cost_shapes():
+    from spark_languagedetector_tpu.telemetry.cost import normalize_cost
+
+    assert normalize_cost({"flops": 10.0, "bytes accessed": 5.0}) == {
+        "flops": 10.0, "bytes_accessed": 5.0
+    }
+    assert normalize_cost([{"flops": 3.0}]) == {"flops": 3.0}
+    assert normalize_cost([]) is None
+    assert normalize_cost(None) is None
+    assert normalize_cost({"flops": -1.0}) is None
+
+
+def test_stage_summary_joins_cost_and_utilization():
+    from spark_languagedetector_tpu.telemetry.cost import record_program_cost
+
+    reg = Registry()
+
+    class Fenced:
+        def block_until_ready(self):
+            pass
+
+    with span("score/dispatch", registry=reg, fence=True) as sp:
+        sp.fence(Fenced())
+    record_program_cost(
+        "score/dispatch",
+        {"flops": 1e9, "bytes_accessed": 1e6},
+        platform="cpu",
+        registry=reg,
+    )
+    entry = reg.stage_summary()["score/dispatch"]
+    assert entry["est_flops_per_call"] == pytest.approx(1e9)
+    assert entry["est_flops_per_s"] > 0
+    assert 0 < entry["flops_utilization"]
+    assert 0 < entry["bytes_utilization"]
+    assert entry["roofline_bound"] in ("compute", "memory")
+    # Peaks and program cost export as plain gauges too (Prometheus).
+    text = render_prometheus(reg)
+    assert 'langdetect_gauge{name="program_flops",program="score/dispatch"}' in text
+    assert 'langdetect_gauge{name="device_peak_flops",device="cpu"}' in text
+
+
+def test_peak_rate_env_overrides(monkeypatch):
+    from spark_languagedetector_tpu.telemetry.cost import peak_rates
+
+    flops, byts = peak_rates("tpu")
+    assert flops > 1e14 and byts > 1e11
+    assert peak_rates("unknown-platform") is None
+    monkeypatch.setenv("LANGDETECT_PEAK_FLOPS", "5e12")
+    f2, b2 = peak_rates("tpu")
+    assert f2 == 5e12 and b2 == byts
+
+
+def test_runner_records_dispatch_cost_once():
+    from spark_languagedetector_tpu import LanguageDetectorModel, Table
+    from spark_languagedetector_tpu.telemetry import REGISTRY
+
+    REGISTRY.reset()
+    model = LanguageDetectorModel.from_gram_map(
+        {b"ab": [1.0, 0.0], b"xy": [0.0, 1.0]}, [2], ["a", "x"]
+    )
+    model.transform(Table({"fulltext": ["ababab", "xyxy"] * 8}))
+    entry = REGISTRY.stage_summary()["score/dispatch"]
+    assert entry.get("est_flops_per_call", 0) > 0
+    assert "flops_utilization" in entry
+    assert getattr(model._get_runner(), "_cost_recorded") is True
+
+
+def test_fit_device_records_count_cost():
+    import numpy as np
+
+    from spark_languagedetector_tpu.ops.fit_tpu import fit_profile_device
+    from spark_languagedetector_tpu.ops.vocab import EXACT, VocabSpec
+    from spark_languagedetector_tpu.telemetry import REGISTRY
+
+    REGISTRY.reset()
+    fit_profile_device(
+        [b"abab", b"xyxy", b"abxy"], np.asarray([0, 1, 0]), 2,
+        VocabSpec(EXACT, (1, 2)), 50,
+    )
+    entry = REGISTRY.stage_summary()["fit/count"]
+    assert entry.get("est_flops_per_call", 0) > 0
+
+
+# ---------------------------------------------------------------- compare CLI --
+FIXTURE_REGRESSED = os.path.join(
+    os.path.dirname(__file__), "fixtures",
+    "telemetry_fixture_regressed.jsonl",
+)
+
+
+def test_compare_cli_same_capture_passes(capsys):
+    from spark_languagedetector_tpu.telemetry.compare import main as c_main
+
+    assert c_main([FIXTURE, FIXTURE]) == 0
+    assert "no regression" in capsys.readouterr().out
+
+
+def test_compare_cli_flags_injected_regression(capsys):
+    """The acceptance gate: a capture with an injected dispatch p99
+    regression exits nonzero and names the offending stage/metric."""
+    from spark_languagedetector_tpu.telemetry.compare import main as c_main
+
+    assert c_main([FIXTURE, FIXTURE_REGRESSED]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out
+    assert re.search(r"score/dispatch\s+p99", out)
+    # Snapshot-carried histograms are compared too.
+    assert "score/batch_latency_s" in out
+
+
+def test_compare_cli_threshold_and_direction(capsys):
+    from spark_languagedetector_tpu.telemetry.compare import main as c_main
+
+    # A generous threshold admits the same diff.
+    assert c_main([FIXTURE, FIXTURE_REGRESSED, "--threshold", "5.0"]) == 0
+    # Reversed order: the "regressed" capture as baseline means the
+    # candidate got FASTER — wall metrics must not flag improvements...
+    capsys.readouterr()
+    rc = c_main([FIXTURE_REGRESSED, FIXTURE, "--threshold", "0.9"])
+    assert rc == 0
+
+
+def test_compare_cli_fill_ratio_is_higher_better(tmp_path, capsys):
+    from spark_languagedetector_tpu.telemetry.compare import main as c_main
+
+    def capture(path, fill):
+        path.write_text(
+            json.dumps({
+                "event": "telemetry.span", "ts": 1.0, "path": "score",
+                "wall_s": 0.01,
+            }) + "\n" + json.dumps({
+                "event": "telemetry.snapshot", "ts": 2.0, "counters": {},
+                "gauges": {},
+                "histograms": {"score/batch_fill_ratio": {
+                    "count": 4, "sum": 4 * fill, "mean": fill, "p50": fill,
+                    "p99": fill,
+                }},
+            }) + "\n"
+        )
+
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    capture(a, 0.9)
+    capture(b, 0.3)  # fill collapsed: a regression even though "lower"
+    assert c_main([str(a), str(b)]) == 1
+    assert "batch_fill_ratio" in capsys.readouterr().out
+    assert c_main([str(b), str(a)]) == 0  # improved fill never flags
+
+
+def test_compare_cli_usage_and_io_errors(tmp_path, capsys):
+    from spark_languagedetector_tpu.telemetry.compare import main as c_main
+
+    assert c_main([]) == 2
+    assert c_main([FIXTURE]) == 2
+    assert c_main([FIXTURE, FIXTURE, "--bogus"]) == 2
+    assert c_main([FIXTURE, FIXTURE, "--threshold"]) == 2
+    assert c_main([str(tmp_path / "nope.jsonl"), FIXTURE]) == 2
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert c_main([str(empty), FIXTURE]) == 2  # nothing comparable
+
+
+# ------------------------------------------------------ report CLI hardening --
+def test_report_empty_capture_renders_message(tmp_path, capsys):
+    p = tmp_path / "empty.jsonl"
+    p.write_text("")
+    assert report_main([str(p)]) == 0
+    assert "empty capture" in capsys.readouterr().out
+
+
+def test_report_snapshot_only_capture(tmp_path, capsys):
+    p = tmp_path / "snap.jsonl"
+    p.write_text(json.dumps({
+        "event": "telemetry.snapshot", "ts": 1.0,
+        "counters": {"jax/compile_events": 3},
+        "gauges": {"live_buffer_bytes": {"device=cpu:0": 64.0}},
+        "histograms": {"score/batch_fill_ratio": {
+            "count": 1, "sum": 0.5, "mean": 0.5, "p50": 0.5, "p99": 0.5,
+        }},
+    }) + "\n")
+    assert report_main([str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "no span events found" in out
+    assert "jax/compile_events" in out and "live_buffer_bytes" in out
+
+
+def test_report_malformed_snapshot_sections_do_not_raise(tmp_path, capsys):
+    """Hand-edited/truncated captures: wrong-typed snapshot sections must
+    degrade to skipped entries, never to a traceback."""
+    p = tmp_path / "bad.jsonl"
+    p.write_text(
+        "not json at all\n"
+        + json.dumps({"event": "telemetry.span", "ts": 1.0, "path": "a",
+                      "wall_s": 0.1}) + "\n"
+        + json.dumps({
+            "event": "telemetry.snapshot", "ts": 2.0,
+            "counters": "not-a-dict",
+            "gauges": {"g": "not-a-dict", 7: {"x": 1.0}},
+            "histograms": {
+                "h1": "not-a-dict",
+                "h2": {"count": 2, "mean": "NaNish"},
+                "h3": {"count": 1, "sum": 0.1, "mean": 0.1, "p50": 0.1,
+                       "p99": 0.1},
+            },
+        }) + "\n"
+    )
+    assert report_main([str(p)]) == 0
+    out = capsys.readouterr().out
+    assert re.search(r"^a\s+1\b", out, re.M)
+    assert "h3" in out and "h2" not in out
+
+
+# ------------------------------------------------------- profiling satellites --
+def test_trace_writes_per_call_subdirs_and_survives_exceptions(
+    tmp_path, monkeypatch
+):
+    from spark_languagedetector_tpu.telemetry import REGISTRY
+    from spark_languagedetector_tpu.utils.profiling import trace
+
+    monkeypatch.setenv("LANGDETECT_TRACE_DIR", str(tmp_path))
+    REGISTRY.reset()
+    with trace(label="score"):
+        pass
+    with pytest.raises(ValueError):
+        with trace(label="score"):
+            raise ValueError("traced region blew up")
+    subdirs = sorted(d for d in os.listdir(tmp_path) if d.startswith("score-"))
+    assert len(subdirs) == 2 and subdirs[0] != subdirs[1]
+    # Both captures (including the raising one) recorded the profiler span.
+    assert REGISTRY.histograms["span:profile/trace"].count == 2
+
+
+def test_trace_does_not_reroot_inner_stage_spans(tmp_path, monkeypatch):
+    """profile/trace is recorded as a root-level sibling, never as the
+    ambient parent: with LANGDETECT_TRACE_DIR set, the stage tree (and
+    the cost-gauge join and cross-capture compare keyed on it) must keep
+    its normal 'score/...' paths, not 'profile/trace/score/...'."""
+    from spark_languagedetector_tpu.telemetry import REGISTRY, trace_request
+    from spark_languagedetector_tpu.utils.profiling import trace
+
+    monkeypatch.setenv("LANGDETECT_TRACE_DIR", str(tmp_path))
+    REGISTRY.reset()
+    sink = _ListSink()
+    REGISTRY.add_sink(sink)
+    try:
+        with trace_request("aaaa00000000000f"), trace(label="score"):
+            with span("score"):
+                with span("score/dispatch"):
+                    pass
+    finally:
+        REGISTRY.remove_sink(sink)
+    stages = REGISTRY.stage_summary()
+    assert "score" in stages and "score/dispatch" in stages
+    assert not any(p.startswith("profile/trace/") for p in stages)
+    assert "profile/trace" in stages
+    # The profiler record still carries request/thread attribution.
+    prof = [e for e in sink.events if e.get("path") == "profile/trace"]
+    assert prof and prof[0]["trace_id"] == "aaaa00000000000f"
+    assert isinstance(prof[0]["tid"], int)
+
+
+def test_trace_noop_without_dir(monkeypatch):
+    from spark_languagedetector_tpu.telemetry import REGISTRY
+    from spark_languagedetector_tpu.utils.profiling import trace
+
+    monkeypatch.delenv("LANGDETECT_TRACE_DIR", raising=False)
+    REGISTRY.reset()
+    with trace():
+        pass
+    assert "span:profile/trace" not in REGISTRY.histograms
+
+
+# -------------------------------------------------- smoke capture → Perfetto --
+def test_smoke_telemetry_capture_exports_to_perfetto(tmp_path):
+    """Acceptance: a --smoke-telemetry capture renders to valid Perfetto
+    trace-event JSON (monotonic per-lane ts, trace ids in args) and the
+    smoke result points at a real flight-recorder post-mortem."""
+    import bench
+    from spark_languagedetector_tpu.telemetry.tracing import main as t_main
+
+    jsonl = str(tmp_path / "smoke.jsonl")
+    result = bench.smoke_telemetry(jsonl)
+    assert result["flight_recorder"]["exercised"] is True
+    assert os.path.exists(result["flight_recorder"]["dump"])
+    assert result["flight_recorder"]["events"] > 0
+    out = str(tmp_path / "smoke.trace.json")
+    assert t_main([jsonl, out]) == 0
+    with open(out) as fh:
+        complete = _valid_chrome_trace(json.load(fh))
+    assert len(complete) >= 4
+    smoke_tid = result["telemetry"]["trace_id"]
+    assert any(
+        e["args"].get("trace_id") == smoke_tid for e in complete
+    ), "the smoke score call's trace id must be in the exported args"
+    # Cost/utilization gauges landed in the stage breakdown (CPU).
+    disp = result["telemetry"]["stages"]["score/dispatch"]
+    assert disp.get("est_flops_per_call", 0) > 0
+    assert "flops_utilization" in disp

@@ -742,6 +742,182 @@ def smoke_telemetry(jsonl_path: str | None = None) -> dict:
             flightrec.uninstall()
 
 
+def smoke_chaos(jsonl_path: str | None = None) -> dict:
+    """CPU-safe chaos schedule: the bench's recovery-behavior smoke path.
+
+    Two scripted legs under seeded ``FaultPlan``s (seconds, no
+    accelerator), reporting recovery counts and the degraded-mode time
+    share so regressions in recovery behavior show up in the perf
+    trajectory next to the throughput numbers:
+
+      1. **breaker leg** — a model with an aggressive env-tuned breaker
+         takes an injected dispatch fault, trips open, serves exact
+         results through the degradation ladder, then recovers to the
+         fast path once the cooldown elapses;
+      2. **stream leg** — a streaming run under transient stream +
+         dispatch faults and one poison batch, with a DLQ and a
+         checkpoint: the query must complete, outputs must equal the
+         fault-free oracle minus exactly the quarantined poison rows.
+
+    ``oracle_match`` is the hard gate — ``main()`` exits nonzero when the
+    chaos run's outputs disagree with the fault-free run.
+    """
+    import tempfile
+    import time as _time
+
+    from spark_languagedetector_tpu import LanguageDetector, Table
+    from spark_languagedetector_tpu.resilience import faults
+    from spark_languagedetector_tpu.resilience.dlq import DeadLetterQueue
+    from spark_languagedetector_tpu.resilience.faults import FaultPlan
+    from spark_languagedetector_tpu.resilience.policy import RetryPolicy
+    from spark_languagedetector_tpu.stream.microbatch import (
+        memory_source,
+        run_stream,
+    )
+    from spark_languagedetector_tpu.telemetry import REGISTRY
+    from spark_languagedetector_tpu.telemetry.export import JsonlSink
+
+    REGISTRY.reset()
+    path = jsonl_path or os.path.join(
+        tempfile.gettempdir(), f"chaos_smoke_{os.getpid()}.jsonl"
+    )
+    sink = JsonlSink(path)
+    REGISTRY.add_sink(sink)
+    # Leg-1 knobs: breaker trips on the first failure, reopens fast, and
+    # the runner policy fails fast (the ladder, not the replay, is under
+    # test). Restored before the stream leg builds its runner.
+    overrides = {
+        "LANGDETECT_BREAKER_THRESHOLD": "1",
+        "LANGDETECT_BREAKER_COOLDOWN_S": "0.05",
+        "LANGDETECT_RETRY_MAX_ATTEMPTS": "1",
+        "LANGDETECT_RETRY_BASE_DELAY_S": "0",
+    }
+    saved = {k: os.environ.get(k) for k in overrides}
+
+    def _restore():
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    mismatches: list[str] = []
+    try:
+        langs = language_names(3)
+        docs, labels = make_corpus(langs, 60, mean_len=200, seed=3)
+        det = LanguageDetector(langs, [1, 2], 200)
+        model = det.fit(Table({"lang": labels, "fulltext": docs}))
+        rows = [{"fulltext": d} for d in docs]
+        oracle: list[str] = []
+        run_stream(
+            model,
+            memory_source(rows, 10),
+            lambda t: oracle.extend(t.column("lang").tolist()),
+        )
+        clean_labels = model.transform(
+            Table({"fulltext": docs[:30]})
+        ).column("lang").tolist()
+
+        # Leg 1: breaker trip -> degraded ladder -> recovery.
+        os.environ.update(overrides)
+        m2 = model.copy()  # fresh runner, built under the leg-1 env
+        with faults.plan_scope(FaultPlan.parse("seed=7;score/dispatch:error@1")):
+            degraded_labels = m2.transform(
+                Table({"fulltext": docs[:30]})
+            ).column("lang").tolist()
+            _time.sleep(0.06)  # past the cooldown: next call probes
+            recovered_labels = m2.transform(
+                Table({"fulltext": docs[:30]})
+            ).column("lang").tolist()
+        if degraded_labels != clean_labels:
+            mismatches.append("breaker leg: degraded labels diverged")
+        if recovered_labels != clean_labels:
+            mismatches.append("breaker leg: post-recovery labels diverged")
+        _restore()
+
+        # Leg 2: streaming chaos — transient faults + one poison batch,
+        # with DLQ + checkpoint.
+        plan = FaultPlan.parse(
+            "seed=7;stream/batch:error@2;score/dispatch:error@6;"
+            "stream/batch:poison=2@3"
+        )
+        poison = plan.poison_rows(3, 10)  # batch 3 == rows 20-29
+        dlq = DeadLetterQueue()
+        ck = os.path.join(
+            tempfile.gettempdir(), f"chaos_smoke_ck_{os.getpid()}.json"
+        )
+        if os.path.exists(ck):
+            os.remove(ck)
+        outputs: list[str] = []
+        m3 = model.copy()
+        with faults.plan_scope(plan):
+            query = run_stream(
+                m3,
+                memory_source(rows, 10),
+                lambda t: outputs.extend(t.column("lang").tolist()),
+                retry_policy=RetryPolicy(max_attempts=3, base_delay_s=0.0),
+                dlq=dlq,
+                checkpoint_path=ck,
+            )
+        poisoned_global = {20 + r for r in poison}
+        expected = [
+            lang for i, lang in enumerate(oracle) if i not in poisoned_global
+        ]
+        if outputs != expected:
+            mismatches.append("stream leg: outputs diverged from oracle")
+        if len(dlq) != len(poison):
+            mismatches.append(
+                f"stream leg: DLQ holds {len(dlq)} rows, expected "
+                f"{len(poison)}"
+            )
+
+        snap = REGISTRY.snapshot()
+        counters = snap["counters"]
+        stages = REGISTRY.stage_summary()
+        degraded_s = sum(
+            v["total_s"] for p, v in stages.items()
+            if p.split("/")[-1] == "degraded"
+        )
+        score_s = sum(
+            v["total_s"] for p, v in stages.items()
+            if p.split("/")[-1] == "score"
+        )
+        return {
+            "smoke_chaos": True,
+            "docs": len(docs),
+            "oracle_match": not mismatches,
+            "mismatches": mismatches,
+            "stream": {
+                "batches": query.batches,
+                "rows": query.rows,
+                "quarantined_batches": query.quarantined_batches,
+                "checkpoint_committed": query.batches + query.resumed_from,
+            },
+            "recoveries": {
+                "retries": counters.get("resilience/retries", 0),
+                "score_retries": counters.get("score/retries", 0),
+                "stream_retries": counters.get("stream/retries", 0),
+                "faults_injected": counters.get(
+                    "resilience/faults_injected", 0
+                ),
+                "breaker_opened": counters.get(
+                    "resilience/breaker_opened", 0
+                ),
+                "degraded_batches": counters.get(
+                    "resilience/degraded_batches", 0
+                ),
+                "dlq_rows": len(dlq),
+            },
+            "degraded_time_share": round(
+                min(1.0, degraded_s / score_s) if score_s else 0.0, 4
+            ),
+            "telemetry": telemetry_block(path),
+        }
+    finally:
+        _restore()
+        REGISTRY.remove_sink(sink)
+
+
 # ------------------------------------------------------------ per config ----
 CONFIGS = {
     # cap: ship maxScoreBytes=256 on the headline config — language identity
@@ -1349,6 +1525,29 @@ def main():
             )
             sys.exit(2)
         print(json.dumps(smoke_telemetry(args[0] if args else None)), flush=True)
+        return
+    if "--smoke-chaos" in sys.argv[1:]:
+        # Chaos smoke path: scripted fault schedule on CPU, one JSON line
+        # out with recovery counts + degraded-mode time share. The
+        # fault-free-oracle parity check is a hard gate, like the main
+        # bench's accuracy parity.
+        args = [a for a in sys.argv[1:] if a != "--smoke-chaos"]
+        flags = [a for a in args if a.startswith("-")]
+        if flags or len(args) > 1:
+            print(
+                f"usage: python bench.py --smoke-chaos [out.jsonl] "
+                f"(got {args})",
+                file=sys.stderr,
+            )
+            sys.exit(2)
+        result = smoke_chaos(args[0] if args else None)
+        print(json.dumps(result), flush=True)
+        if not result["oracle_match"]:
+            print(
+                "chaos smoke FAILED: " + "; ".join(result["mismatches"]),
+                file=sys.stderr,
+            )
+            sys.exit(1)
         return
     order = [
         int(c)

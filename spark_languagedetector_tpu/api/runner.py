@@ -42,16 +42,20 @@ from ..ops.encoding import (
     unpack_ragged,
 )
 from ..ops.vocab import VocabSpec
+from ..resilience import faults
+from ..resilience.policy import CLOSED, CircuitBreaker, RetryPolicy
 from ..telemetry import REGISTRY, flightrec, span, trace_request
 from ..utils.logging import get_logger, log_event
 from ..utils.metrics import Metrics
 
 _log = get_logger("api.runner")
 
-# Failures worth replaying a micro-batch for: runtime/transfer errors from
-# the device or the tunnel (XlaRuntimeError is a RuntimeError subclass) and
-# host I/O. Programming errors (TypeError, ValueError, shape bugs) propagate
-# immediately with their original traceback instead of being re-executed.
+# Legacy shorthand for "transient-shaped" exceptions, kept for the cheap
+# inline guards below (async-copy kickoff). Real replay decisions go
+# through the runner's RetryPolicy classifier
+# (resilience.policy.is_retryable), which additionally refuses
+# RuntimeError subclasses that are programming errors
+# (NotImplementedError, RecursionError).
 RETRYABLE = (RuntimeError, OSError)
 
 # Device-side inverse of the ragged packer (ops.encoding.unpack_ragged),
@@ -232,12 +236,37 @@ class BatchRunner:
     # accuracy cost — the wire is the binding wall for short-gram configs
     # (docs/PERFORMANCE.md §1).
     max_score_bytes: int | None = None
+    # Failure handling (docs/RESILIENCE.md). ``retry_policy`` replays
+    # transient dispatch/fetch failures with backoff (None ⇒ the env-tuned
+    # default: replay-once). ``breaker`` trips after consecutive device
+    # failures and gates the compiled fast path; while it is open (and
+    # ``degraded_fallback`` is on — None ⇒ env ``LANGDETECT_DEGRADED`` not
+    # "0"), scoring rides the degradation ladder (device gather escape
+    # hatch → host scoring) instead of failing the call. Both are disabled
+    # on a multi-process mesh: a fallback taken by one process alone would
+    # desynchronize the process-wide collective schedule.
+    retry_policy: RetryPolicy | None = None
+    breaker: CircuitBreaker | None = None
+    degraded_fallback: bool | None = None
     metrics: Metrics = field(default_factory=Metrics)
 
     def __post_init__(self):
         # Created first: strategy auto-selection below may already resolve
         # lazy state through the lock.
         self._state_lock = threading.Lock()
+        if self.retry_policy is None:
+            self.retry_policy = RetryPolicy.from_env()
+        if self.breaker is None:
+            self.breaker = CircuitBreaker.from_env(name="score")
+        if self.degraded_fallback is None:
+            import os as _os
+
+            self.degraded_fallback = (
+                _os.environ.get("LANGDETECT_DEGRADED", "1") != "0"
+            )
+        # True while the last dispatch rode the degradation ladder; drives
+        # the langdetect_degraded gauge's reset on fast-path recovery.
+        self._degraded_mode = False
         if self.ragged_transfer is None:
             self.ragged_transfer = self.mesh is None
         if self.mesh is not None:
@@ -799,6 +828,10 @@ class BatchRunner:
         return self._dispatch_device(batch, lengths, window_limit, placement)
 
     def _dispatch_device(self, batch, lengths, window_limit, placement):
+        # Chaos hook: an armed FaultPlan can fail/delay this attempt (the
+        # compiled fast path and the degraded ladder's device level both
+        # count as device dispatches).
+        faults.inject("score/dispatch")
         if self.strategy == "pallas":
             interpret, w1, w2 = self._pallas_state()
             return self._pallas_dispatch(
@@ -829,6 +862,167 @@ class BatchRunner:
             )
         return self._gather_scores(
             batch, lengths, window_limit, None, block=self.block
+        )
+
+    # ------------------------------------------- degraded-mode fallback -----
+    def _gather_escape(self, batch, lengths, window_limit):
+        """The strategy lattice's escape hatch, callable regardless of the
+        configured strategy: plain gather/cuckoo scoring on the operands'
+        device. Exact for every profile form (dense table, LUT, cuckoo),
+        so degraded results are bit-identical to ``strategy='gather'``."""
+        if self.cuckoo is not None:
+            return score_ops.score_batch_cuckoo(
+                batch,
+                lengths,
+                self.weights,
+                self._cuckoo_entries,
+                seed1=self.cuckoo.seed1,
+                seed2=self.cuckoo.seed2,
+                spec=self.spec,
+                block=min(self.block, 256),
+                window_limit=window_limit,
+            )
+        return score_ops.score_batch(
+            batch,
+            lengths,
+            self.weights,
+            self.lut,
+            spec=self.spec,
+            block=min(self.block, 256),
+            window_limit=window_limit,
+        )
+
+    def _host_state(self):
+        """(cpu_device, weights, lut, cuckoo_entries) with every array on
+        the host CPU backend — the degradation ladder's last rung. Built
+        lazily at first degraded use (keeping permanent host copies would
+        double resident table memory for a path that normally never runs);
+        if the device is so far gone that even the d2h copy fails, the
+        ladder's final raise carries that error."""
+        state = getattr(self, "_host_cache", None)
+        if state is not None:
+            return state
+        with self._state_lock:
+            state = getattr(self, "_host_cache", None)
+            if state is None:
+                cpu = jax.local_devices(backend="cpu")[0]
+                w = jax.device_put(np.asarray(self.weights), cpu)
+                lut = (
+                    None
+                    if self.lut is None
+                    else jax.device_put(np.asarray(self.lut), cpu)
+                )
+                entries = (
+                    None
+                    if self.cuckoo is None
+                    else jax.device_put(np.asarray(self._cuckoo_entries), cpu)
+                )
+                state = self._host_cache = (cpu, w, lut, entries)
+        return state
+
+    def _host_scores(self, batch_np, lengths_np, limit_np):
+        """Host-interpret scoring: the gather program executed on the CPU
+        backend with host-resident tables — immune to accelerator/tunnel
+        state, and exact (same program, same operands)."""
+        cpu, w, lut, entries = self._host_state()
+        batch = jax.device_put(batch_np, cpu)
+        lengths = jax.device_put(lengths_np, cpu)
+        window_limit = (
+            None if limit_np is None else jax.device_put(limit_np, cpu)
+        )
+        with jax.default_device(cpu):
+            if self.cuckoo is not None:
+                return score_ops.score_batch_cuckoo(
+                    batch,
+                    lengths,
+                    w,
+                    entries,
+                    seed1=self.cuckoo.seed1,
+                    seed2=self.cuckoo.seed2,
+                    spec=self.spec,
+                    block=min(self.block, 256),
+                    window_limit=window_limit,
+                )
+            return score_ops.score_batch(
+                batch,
+                lengths,
+                w,
+                lut,
+                spec=self.spec,
+                block=min(self.block, 256),
+                window_limit=window_limit,
+            )
+
+    def _degraded_scores(
+        self, batch_docs, batch_limits, pad_to, placement, cause=None
+    ):
+        """Run one batch down the degradation ladder after the compiled
+        fast path failed (or while the breaker holds it open):
+
+          1. ``gather`` — the device escape hatch, only meaningful when
+             the fast path is a different program (pallas/hybrid/hist);
+          2. ``host``   — the same gather program on the CPU backend.
+
+        Each level is fenced before it counts as a success, so deferred
+        execution errors surface inside the ladder instead of poisoning
+        the caller's fetch loop. Exact scores at every level — degraded
+        mode trades throughput, never correctness.
+        """
+        if all(lim == self.max_chunk for lim in batch_limits):
+            limit_np = None
+        else:
+            limit_np = np.asarray(batch_limits, dtype=np.int32)
+        batch_np, lengths_np = self._pack(batch_docs, pad_to)
+        levels = ["host"]
+        if self.strategy in ("pallas", "hybrid", "hist"):
+            levels.insert(0, "gather")
+        last = cause
+        for level in levels:
+            try:
+                with span(
+                    "score/degraded", rows=len(batch_docs), pad_to=pad_to,
+                    level=level, degraded=True,
+                ) as sp:
+                    if level == "gather":
+                        faults.inject("score/dispatch")
+                        batch = jax.device_put(batch_np, placement)
+                        lengths = jax.device_put(lengths_np, placement)
+                        window_limit = (
+                            None
+                            if limit_np is None
+                            else jax.device_put(limit_np, placement)
+                        )
+                        scores = self._gather_escape(
+                            batch, lengths, window_limit
+                        )
+                    else:
+                        scores = self._host_scores(
+                            batch_np, lengths_np, limit_np
+                        )
+                    jax.block_until_ready(scores)
+                    sp.fence(scores)
+            except Exception as e:
+                if not self.retry_policy.classify(e):
+                    raise
+                last = e
+                continue
+            self._degraded_mode = True
+            self.metrics.incr("degraded_batches")
+            REGISTRY.incr("resilience/degraded_batches")
+            REGISTRY.incr(f"resilience/degraded_{level}")
+            REGISTRY.set_gauge("langdetect_degraded", 1.0)
+            log_event(
+                _log,
+                "runner.degraded",
+                level=level,
+                rows=len(batch_docs),
+                pad_to=pad_to,
+                breaker=self.breaker.state,
+                cause=repr(cause) if cause is not None else None,
+            )
+            return scores
+        raise last if last is not None else RuntimeError(
+            "degraded ladder exhausted with no recorded cause"
         )
 
     def score(self, byte_docs: Sequence[bytes]) -> np.ndarray:
@@ -1048,23 +1242,82 @@ class BatchRunner:
             sub = scores[jnp.asarray(pos)] if pos.size else None
             return am, sub, pos
 
+        multiproc = self.mesh is not None and jax.process_count() > 1
+
+        def on_retry(attempt_no, delay_s, exc):
+            """Per-retry bookkeeping shared by the dispatch and fetch
+            sites (the structured attempt/backoff/trace_id log line is
+            emitted by RetryPolicy.run itself). List append is
+            GIL-atomic, so dispatch workers need no extra lock."""
+            self.metrics.incr("retries")
+            REGISTRY.incr("score/retries")
+            call_retries.append(1)
+
+        def degraded_for(sel, pad_to, cause):
+            """Assemble the planned batch's docs/limits (mesh pad rows
+            included) and run them down the degradation ladder."""
+            batch_docs = [chunks[k] for k in sel]
+            batch_limits = [limits[k] for k in sel]
+            if self.mesh is not None:
+                batch_docs, batch_limits = pad_rows_for_mesh(
+                    batch_docs, self._ndata, (batch_limits, self.max_chunk)
+                )
+            return self._degraded_scores(
+                batch_docs, batch_limits, pad_to, placement, cause
+            )
+
+        def dispatch_recover(sel, pad_to):
+            """Breaker-gated fast path under the retry policy, then the
+            degradation ladder. On a multi-process mesh (or with the
+            fallback disabled) only the policy replay applies: the chaos
+            plan and the policy are deterministic, so every process
+            replays together and the collective schedule stays aligned —
+            but a per-process fallback would not."""
+            fast = lambda: build_and_dispatch(sel, pad_to)  # noqa: E731
+            if multiproc or not self.degraded_fallback:
+                return self.retry_policy.run(
+                    fast, site="score/dispatch", on_retry=on_retry,
+                    log_fields={"rows": len(sel)},
+                )
+            cause = None
+            if self.breaker.allow():
+                try:
+                    scores = self.retry_policy.run(
+                        fast,
+                        site="score/dispatch",
+                        breaker=self.breaker,
+                        on_retry=on_retry,
+                        log_fields={"rows": len(sel)},
+                    )
+                except Exception as e:
+                    if not self.retry_policy.classify(e):
+                        raise
+                    cause = e
+                else:
+                    if self._degraded_mode and self.breaker.state == CLOSED:
+                        # Fast path healthy again AND the breaker agrees
+                        # (a success that only half-opened a multi-probe
+                        # breaker isn't recovery yet): leave degraded mode
+                        # and say so on the gauge.
+                        self._degraded_mode = False
+                        REGISTRY.set_gauge("langdetect_degraded", 0.0)
+                        log_event(_log, "runner.degraded_recovered")
+                    return scores
+            else:
+                REGISTRY.incr("resilience/breaker_short_circuit")
+            return degraded_for(sel, pad_to, cause)
+
         def run_one(item):
-            """Pack, dispatch, and project one planned batch (retry once on
-            transient failure). Async dispatch: the device works while other
-            batches pack. Only (sel, pad_to) is retained for replay — the
-            padded arrays are rebuilt from `chunks` in the rare
-            fetch-failure path, so peak host RSS stays O(workers × batch),
-            not O(corpus)."""
+            """Pack, dispatch, and project one planned batch (transient
+            failures replay under the retry policy; a tripped breaker
+            reroutes to the degradation ladder). Async dispatch: the
+            device works while other batches pack. Only (sel, pad_to) is
+            retained for replay — the padded arrays are rebuilt from
+            `chunks` in the rare fetch-failure path, so peak host RSS
+            stays O(workers × batch), not O(corpus)."""
             sel, pad_to = item
             t0 = time.perf_counter()
-            try:
-                scores = build_and_dispatch(sel, pad_to)
-            except RETRYABLE as e:
-                log_event(_log, "runner.retry", rows=len(sel), error=repr(e))
-                self.metrics.incr("retries")
-                REGISTRY.incr("score/retries")
-                call_retries.append(1)
-                scores = build_and_dispatch(sel, pad_to)
+            scores = dispatch_recover(sel, pad_to)
             self.metrics.incr("chunks_scored", len(sel))
             REGISTRY.observe(
                 "score/batch_latency_s", time.perf_counter() - t0
@@ -1114,7 +1367,6 @@ class BatchRunner:
             # (measured ~8ms over a tunneled TPU). Multi-process meshes skip
             # the prefetch: results are assembled via process_allgather in
             # _fetch, and a host copy of non-addressable shards can't start.
-            multiproc = self.mesh is not None and jax.process_count() > 1
             with span("score/fetch", batches=len(plan)):
                 for _, s, _ in (pending if not multiproc else ()):
                     arrays = (s,) if not want_labels else (s[0], s[1])
@@ -1131,37 +1383,70 @@ class BatchRunner:
                             pass
                 for sel, s, pad_to in pending:
                     try:
+                        faults.inject("score/fetch")
                         if want_labels:
                             am, sub, pos = s
                             am_host = self._fetch(am)
                             sub_host = None if sub is None else self._fetch(sub)
                         else:
                             host = self._fetch(s)
-                    except RETRYABLE as e:
+                    except Exception as e:
                         # A failure surfacing only at fetch time (async
-                        # dispatch defers execution errors here): replay the
-                        # batch once, synchronously. NOT on a multi-process
-                        # mesh: a replay enqueues fresh collectives on this
-                        # process alone, desynchronizing the process-wide
-                        # collective schedule _fetch depends on — propagate
-                        # instead (the caller's whole call is replayable on
-                        # every process together).
-                        if multiproc:
+                        # dispatch defers execution errors here): replay
+                        # the batch synchronously under the retry policy,
+                        # then fall to the degradation ladder. NOT on a
+                        # multi-process mesh: a replay enqueues fresh
+                        # collectives on this process alone,
+                        # desynchronizing the process-wide collective
+                        # schedule _fetch depends on — propagate instead
+                        # (the caller's whole call is replayable on every
+                        # process together). Deterministic errors
+                        # propagate with their original traceback.
+                        if multiproc or not self.retry_policy.classify(e):
                             raise
-                        log_event(
-                            _log, "runner.retry_fetch", rows=len(sel),
-                            error=repr(e),
-                        )
-                        self.metrics.incr("retries")
-                        REGISTRY.incr("score/retries")
-                        call_retries.append(1)
-                        scores = build_and_dispatch(sel, pad_to)
-                        if want_labels:
-                            am, sub, pos = project(sel, scores)
-                            am_host = self._fetch(am)
-                            sub_host = None if sub is None else self._fetch(sub)
+
+                        def replay(sel=sel, pad_to=pad_to):
+                            faults.inject("score/fetch")
+                            scores = build_and_dispatch(sel, pad_to)
+                            if want_labels:
+                                am_r, sub_r, pos_r = project(sel, scores)
+                                return (
+                                    self._fetch(am_r),
+                                    None if sub_r is None
+                                    else self._fetch(sub_r),
+                                    pos_r,
+                                )
+                            return (self._fetch(scores),)
+
+                        try:
+                            fetched = self.retry_policy.run(
+                                replay,
+                                site="score/fetch",
+                                breaker=self.breaker,
+                                on_retry=on_retry,
+                                initial_error=e,
+                                log_fields={"rows": len(sel)},
+                            )
+                        except Exception as e2:
+                            if (
+                                not self.degraded_fallback
+                                or not self.retry_policy.classify(e2)
+                            ):
+                                raise
+                            scores = degraded_for(sel, pad_to, e2)
+                            if want_labels:
+                                am, sub, pos = project(sel, scores)
+                                am_host = self._fetch(am)
+                                sub_host = (
+                                    None if sub is None else self._fetch(sub)
+                                )
+                            else:
+                                host = self._fetch(scores)
                         else:
-                            host = self._fetch(scores)
+                            if want_labels:
+                                am_host, sub_host, pos = fetched
+                            else:
+                                (host,) = fetched
                     # Rows beyond len(sel) are mesh pad rows — dropped here.
                     if want_labels:
                         docs_of = doc_idx_arr[sel]

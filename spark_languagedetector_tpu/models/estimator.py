@@ -239,7 +239,16 @@ class LanguageDetector(_DetectorParams):
         # Root telemetry span: the count/weights/topk stage spans recorded
         # by ops.fit / ops.fit_tpu nest under "fit" (docs/OBSERVABILITY.md).
         # One request trace per fit; a raising fit dumps the flight
-        # recorder's ring (when armed) before propagating.
+        # recorder's ring (when armed) before propagating. Transient
+        # device/runtime failures replay the whole fit under the env-tuned
+        # retry policy — the fit builds its accumulator from scratch each
+        # attempt, so replay is exact; on a multi-process mesh the policy
+        # and any armed fault plan are deterministic, so every process
+        # replays together and collectives stay aligned
+        # (docs/RESILIENCE.md §5).
+        from ..resilience.policy import RetryPolicy
+
+        policy = RetryPolicy.from_env()
         try:
             with trace_request(), span(
                 "fit",
@@ -247,7 +256,11 @@ class LanguageDetector(_DetectorParams):
                 backend=self.get("fitBackend"),
                 languages=len(supported),
             ):
-                ids, weights = self._fit_profile(spec, docs, lang_idx, supported)
+                ids, weights = policy.run(
+                    lambda: self._fit_profile(spec, docs, lang_idx, supported),
+                    site="fit/count",
+                    log_fields={"rows": dataset.num_rows},
+                )
         except Exception as e:
             flightrec.record_crash("fit", e)
             raise

@@ -211,6 +211,9 @@ def fit_profile_numpy(
 ) -> tuple[np.ndarray, np.ndarray]:
     """Full host fit: returns (sorted gram ids [G], weights [G, L] float64)."""
     with span("fit/count", docs=len(byte_docs), backend="cpu"):
+        from ..resilience import faults
+
+        faults.inject("fit/count")  # chaos hook: one count pass per attempt
         gram_counts = extract_gram_counts(
             byte_docs, lang_indices, num_langs, spec
         )

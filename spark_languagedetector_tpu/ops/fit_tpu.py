@@ -359,7 +359,10 @@ def fit_profile_device(
     with span(
         "fit/count", docs=len(byte_docs), backend="device", shards=ndata
     ) as count_span:
+        from ..resilience import faults
+
         for start in range(0, len(order), batch_rows):
+            faults.inject("fit/count")  # chaos hook: one call per count step
             sel = order[start : start + batch_rows]
             docs = [byte_docs[i] for i in sel]
             langs = lang_arr[sel]

@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops import fit_tpu
 from ..ops.score import score_batch
 from ..ops.vocab import VocabSpec
+from ..resilience import faults
 from ..telemetry import span
 from .mesh import DATA_AXIS, VOCAB_AXIS, batch_sharding, replicated, vocab_sharding
 
@@ -127,6 +128,12 @@ def make_sharded_fit_step(
     steps = itertools.count()
 
     def timed_step(batch, lengths, lang_ids, counts_acc):
+        # Chaos hook BEFORE the dispatch: an injected failure surfaces
+        # before any collective is enqueued, so every process of a
+        # multi-host mesh (running the same deterministic plan) fails the
+        # same step together and the estimator-level retry replays them
+        # in lockstep.
+        faults.inject("shard_step")
         with span(
             "shard_step",
             shards=ndata,

@@ -22,6 +22,10 @@ name. :func:`load_model` reads BOTH layouts (column names decide), and
 ``save_model(..., layout="reference")`` writes the Scala layout so a model
 trained here loads in the Spark implementation (exact vocabs only — the
 reference has no hashed mode).
+
+Model/pipeline persistence lives here; the streaming engine's per-batch
+resume tokens (the Structured-Streaming-offset-log analog) are the
+sibling :mod:`.checkpoint` module — tiny atomic JSON, not parquet.
 """
 
 from __future__ import annotations

@@ -1,0 +1,56 @@
+"""Resilience subsystem: retry policies, circuit breaking, chaos testing.
+
+The serving layers' failure handling used to be two hard-coded "retry
+once" sites (runner dispatch/fetch, stream transform) with no deadlines,
+no backoff, no input quarantine, and no way to exercise any of it
+deterministically. TPU-fleet practice treats preemption and runtime
+faults as routine and recovers via replay (PAPERS.md — the pjit/TPUv4
+systems papers); the Spark Structured Streaming model the reference
+implicitly relied on provides offset checkpointing and task retry for
+free. This package supplies the TPU-native equivalents:
+
+  * :mod:`.policy` — :class:`RetryPolicy` (bounded attempts, exponential
+    backoff with deterministic seeded jitter, per-attempt deadlines, a
+    retryable-exception classifier) and :class:`CircuitBreaker`
+    (closed → open → half-open on consecutive device failures), both
+    emitting telemetry (``langdetect_retry_attempts``,
+    ``langdetect_breaker_state``).
+  * :mod:`.faults` — a deterministic chaos layer: a :class:`FaultPlan`
+    (env ``LANGDETECT_FAULT_PLAN`` or test hooks) injects
+    XlaRuntimeError-shaped failures, latency spikes, and poison rows at
+    named sites with a seeded schedule, so every recovery path is
+    exercisable on CPU in tier-1.
+  * :mod:`.dlq` — a dead-letter queue that quarantines rows a streaming
+    batch cannot score instead of terminating the query.
+
+The streaming engine (:mod:`..stream.microbatch`) layers per-batch
+checkpointing and poison-row bisection on top; the batch runner
+(:mod:`..api.runner`) layers the breaker-gated degraded-mode fallback
+chain (compiled fast path → device gather → host scoring). See
+``docs/RESILIENCE.md`` for the full contract.
+"""
+
+from __future__ import annotations
+
+from .dlq import DeadLetterQueue
+from .faults import FaultPlan, InjectedFault, PoisonRowError, PoisonText
+from .policy import (
+    BreakerOpen,
+    CircuitBreaker,
+    DeadlineExceeded,
+    RetryPolicy,
+    is_retryable,
+)
+
+__all__ = [
+    "BreakerOpen",
+    "CircuitBreaker",
+    "DeadLetterQueue",
+    "DeadlineExceeded",
+    "FaultPlan",
+    "InjectedFault",
+    "PoisonRowError",
+    "PoisonText",
+    "RetryPolicy",
+    "is_retryable",
+]

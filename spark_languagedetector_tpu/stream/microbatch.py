@@ -11,6 +11,20 @@ Sources are any ``Iterable[Table]``; adapters below wrap an in-memory list
 (tests/bench) and a Kafka consumer (gated on ``kafka-python`` being
 installed — not baked into this image, so it degrades to a clear error, the
 same way Spark requires the kafka connector JAR on the classpath).
+
+Failure handling (docs/RESILIENCE.md) supplies the durability Structured
+Streaming provided for free:
+
+  * transient transform failures replay under a :class:`RetryPolicy`
+    (classified — deterministic errors are never futilely replayed, and
+    ``KeyboardInterrupt``/``SystemExit`` are never swallowed);
+  * with a ``checkpoint_path``, every sunk batch commits a resume token
+    (atomic JSON via :mod:`..persist.checkpoint`); a restarted run
+    fast-forwards the source past committed batches and re-emits nothing;
+  * with a ``dlq``, a batch that fails *deterministically* is bisected to
+    the poison rows — healthy rows are scored and sunk in order, the
+    poison rows are quarantined with full context — instead of killing
+    the query.
 """
 
 from __future__ import annotations
@@ -22,6 +36,9 @@ from dataclasses import dataclass, field
 from typing import Callable, Iterable, Iterator, Sequence
 
 from ..api.table import Table
+from ..resilience import faults
+from ..resilience.dlq import DeadLetterQueue
+from ..resilience.policy import CircuitBreaker, RetryPolicy
 from ..telemetry import REGISTRY, flightrec, new_trace_id, span, trace_request
 from ..utils.logging import get_logger, log_event
 from ..utils.metrics import Metrics
@@ -92,10 +109,22 @@ class StreamingQuery:
     # lets an on_progress hook tie a slow batch back to its spans in the
     # JSONL capture (bench records the slowest one per config).
     last_batch_trace_id: str | None = None
+    # Resilience accounting: source batches skipped because a checkpoint
+    # said they were already committed; batches routed through the
+    # quarantine/bisect path; rows this run handed to the DLQ.
+    resumed_from: int = 0
+    quarantined_batches: int = 0
+    dlq_rows: int = 0
 
     @property
     def rows_per_second(self) -> float:
         return self.metrics.throughput("rows", "total_s")
+
+
+def _slice_table(table: Table, lo: int, hi: int) -> Table:
+    return Table(
+        {n: table.column(n)[lo:hi] for n in table.schema.names}, table.schema
+    )
 
 
 def run_stream(
@@ -107,13 +136,42 @@ def run_stream(
     on_progress: Callable[[StreamingQuery], None] | None = None,
     prefetch: int = 0,
     workers: int | None = None,
+    retry_policy: RetryPolicy | None = None,
+    breaker: CircuitBreaker | None = None,
+    dlq: DeadLetterQueue | None = None,
+    checkpoint_path: str | None = None,
 ) -> StreamingQuery:
     """Drive the micro-batch loop: for each source batch, transform on the
     accelerator and hand the annotated table to the sink.
 
-    Scoring is stateless, so failure recovery is replay: a batch that raises
-    can be re-submitted verbatim (SURVEY.md §5.3) — the engine retries once
-    before propagating, covering transient device/tunnel hiccups.
+    Scoring is stateless, so failure recovery is replay: a batch whose
+    transform raises a *retryable* error (``resilience.policy.is_retryable``
+    — device/tunnel runtime errors, host I/O) is re-submitted under
+    ``retry_policy`` (default: the env-tuned ``RetryPolicy.from_env()``,
+    replay-once with backoff) before any further handling. Deterministic
+    errors (a bad column's ``ValueError``, a poison row) are never
+    replayed: with a ``dlq`` they take the quarantine path below, without
+    one they propagate at once. ``KeyboardInterrupt``/``SystemExit`` are
+    never swallowed anywhere in the loop.
+
+    ``dlq``: a batch that still fails after classification/retries is
+    bisected — halves that transform cleanly are sunk (in source order;
+    the sink may therefore see several sub-batches for one source batch),
+    rows that fail alone are quarantined with their batch/row index and
+    error. The query keeps running; ``query.dlq_rows`` counts the damage.
+
+    ``checkpoint_path``: after each batch is fully sunk (or settled via
+    the DLQ), a resume token ``{"committed": seq + 1}`` is atomically
+    persisted. A later ``run_stream`` with the same path fast-forwards
+    the (replayed-from-the-start) source past the committed batches, so a
+    mid-stream kill re-emits nothing already sunk. The commit happens
+    *after* the sink returns: a batch whose sink raised replays on
+    resume — at-least-once for the crashing batch, exactly-once for
+    everything committed.
+
+    ``breaker``: optional health monitor — per-batch transform outcomes
+    are recorded on it (the degraded-mode *gating* lives in the model
+    runner's own breaker; see docs/RESILIENCE.md §6).
 
     ``prefetch > 0`` overlaps batch N+1's transform with batch N's result
     fetch and sink; sinks always run in the caller's thread, in source
@@ -132,6 +190,26 @@ def run_stream(
     """
     query = StreamingQuery()
     it = iter(source)
+    policy = retry_policy if retry_policy is not None else RetryPolicy.from_env()
+    input_col = getattr(model, "get_input_col", lambda: None)()
+
+    # Resume: fast-forward past batches a previous run already committed.
+    committed = 0
+    if checkpoint_path is not None:
+        from ..persist.checkpoint import load_checkpoint
+
+        state = load_checkpoint(checkpoint_path) or {}
+        committed = max(0, int(state.get("committed", 0)))
+        skipped = 0
+        while skipped < committed:
+            try:
+                next(it)
+            except StopIteration:
+                break
+            skipped += 1
+        query.resumed_from = skipped
+        if skipped:
+            log_event(_log, "stream.resume", committed=skipped)
 
     def transform_once(batch: Table, seq: int, trace_id: str) -> Table:
         # Runs on a prefetch worker thread when the pipeline is deep: the
@@ -141,26 +219,85 @@ def run_stream(
         # trace id (minted when the batch was pulled) is rebound here so
         # the nested runner score spans attribute to this batch's request
         # rather than the stream root.
+        def attempt():
+            faults.inject("stream/batch")
+            return model.transform(batch)
+
+        def on_retry(attempt_no, delay_s, exc):
+            # May run on the worker thread concurrently with the caller's
+            # counter writes — Metrics serializes internally.
+            query.metrics.incr("retries")
+            REGISTRY.incr("stream/retries")
+            log_event(
+                _log, "stream.retry", batch=seq, attempt=attempt_no,
+                backoff_s=round(delay_s, 6), trace_id=trace_id,
+                error=repr(exc),
+            )
+
         with trace_request(trace_id), span(
             "stream/transform", parent=stream_span, batch=seq,
             rows=batch.num_rows,
         ):
+            return policy.run(
+                attempt,
+                site="stream/batch",
+                breaker=breaker,
+                on_retry=on_retry,
+            )
+
+    def settle(tbl: Table, seq: int, base: int, error: BaseException) -> None:
+        """Bisect a deterministically-failing batch: sink the rows that
+        score cleanly (in order), quarantine the rows that fail alone.
+        Probes call ``model.transform`` directly (under the retry policy
+        for stray transients) — deliberately bypassing the ``stream/batch``
+        chaos site, so an injected transient cannot masquerade as poison
+        during isolation. Only *deterministic* failures recurse toward the
+        DLQ: a retryable error that exhausts the policy mid-bisection is
+        an outage, not poison — it propagates (crashing the batch; its
+        commit never happens, so a resume replays it whole) instead of
+        quarantining healthy rows."""
+        if policy.classify(error):
+            raise error
+        if tbl.num_rows <= 1:
+            for i, row in enumerate(tbl.to_rows()):
+                dlq.put(
+                    batch=seq, row_index=base + i, row=row, error=repr(error)
+                )
+                query.dlq_rows += 1
+                query.metrics.incr("dlq_rows")
+            return
+        mid = tbl.num_rows // 2
+        for lo, hi in ((0, mid), (mid, tbl.num_rows)):
+            sub = _slice_table(tbl, lo, hi)
             try:
-                return model.transform(batch)
-            except Exception:  # transient failure: replay once (stateless)
-                log_event(_log, "stream.retry", batch=seq, trace_id=trace_id)
-                # May run on the worker thread concurrently with the
-                # caller's counter writes — Metrics serializes internally.
-                query.metrics.incr("retries")
-                REGISTRY.incr("stream/retries")
-                return model.transform(batch)
+                out = policy.run(
+                    lambda sub=sub: model.transform(sub),
+                    site="stream/bisect",
+                )
+            except Exception as sub_error:
+                settle(sub, seq, base + lo, sub_error)
+            else:
+                with span("sink", rows=sub.num_rows):
+                    sink(out)
+
+    def quarantine(tbl: Table, seq: int, trace_id: str,
+                   error: BaseException) -> None:
+        query.quarantined_batches += 1
+        query.metrics.incr("quarantined_batches")
+        REGISTRY.incr("resilience/quarantined_batches")
+        log_event(
+            _log, "stream.quarantine", batch=seq, rows=tbl.num_rows,
+            error=repr(error), trace_id=trace_id,
+        )
+        with span("quarantine", batch=seq, rows=tbl.num_rows):
+            settle(tbl, seq, 0, error)  # nests as stream/batch/quarantine
 
     n_workers = workers if workers is not None else min(2, max(prefetch, 1))
     executor = (
         ThreadPoolExecutor(max_workers=n_workers) if prefetch > 0 else None
     )
     in_flight: deque = deque()  # (batch, seq, trace_id, future-or-None)
-    seq = 0
+    seq = committed
     try:
         with span(
             "stream", prefetch=prefetch, workers=n_workers
@@ -180,6 +317,9 @@ def run_stream(
                     except StopIteration:
                         want_more = False
                 if batch is not None:
+                    # Chaos hook: a plan with a poison spec corrupts rows
+                    # of this source batch (deterministic per batch count).
+                    batch, _ = faults.corrupt_batch(batch, input_col)
                     # Each source batch is one request: its trace id is
                     # minted at pull time and travels with the batch
                     # through the prefetch worker and the drain loop.
@@ -207,21 +347,34 @@ def run_stream(
                     ), span(
                         "stream/batch", batch=src_seq, rows=src.num_rows
                     ):
-                        if fut is None:
-                            out = transform_once(src, src_seq, src_tid)
+                        try:
+                            if fut is None:
+                                out = transform_once(src, src_seq, src_tid)
+                            else:
+                                # Sink-visible stall: how long the drain sat
+                                # waiting on the prefetch worker — the signal
+                                # separating "wire is behind" from "sink is
+                                # behind" when stream throughput drops.
+                                t_wait = time.perf_counter()
+                                out = fut.result()
+                                REGISTRY.observe(
+                                    "stream/prefetch_stall_s",
+                                    time.perf_counter() - t_wait,
+                                )
+                        except Exception as e:
+                            # Retryable errors already exhausted the policy
+                            # inside transform_once; what reaches here is
+                            # either deterministic (→ quarantine when a DLQ
+                            # is wired) or a device outage the runner's
+                            # degraded ladder could not absorb (→ propagate:
+                            # quarantining healthy data during an outage
+                            # would turn downtime into data loss).
+                            if dlq is None or policy.classify(e):
+                                raise
+                            quarantine(src, src_seq, src_tid, e)
                         else:
-                            # Sink-visible stall: how long the drain sat
-                            # waiting on the prefetch worker — the signal
-                            # separating "wire is behind" from "sink is
-                            # behind" when stream throughput drops.
-                            t_wait = time.perf_counter()
-                            out = fut.result()
-                            REGISTRY.observe(
-                                "stream/prefetch_stall_s",
-                                time.perf_counter() - t_wait,
-                            )
-                        with span("sink", rows=src.num_rows):
-                            sink(out)  # nests as stream/batch/sink
+                            with span("sink", rows=src.num_rows):
+                                sink(out)  # nests as stream/batch/sink
                     dt = time.perf_counter() - t0
                     query.batches += 1
                     query.rows += src.num_rows
@@ -230,6 +383,20 @@ def run_stream(
                     query.last_batch_trace_id = src_tid
                     query.metrics.incr("rows", src.num_rows)
                     query.metrics.incr("batches")
+                    if checkpoint_path is not None:
+                        # Commit AFTER the sink (or quarantine) settled the
+                        # batch: the resume token only ever names batches
+                        # whose effects are fully externalized.
+                        from ..persist.checkpoint import save_checkpoint
+
+                        save_checkpoint(
+                            checkpoint_path,
+                            {
+                                "committed": src_seq + 1,
+                                "rows": query.rows,
+                                "dlq_rows": query.dlq_rows,
+                            },
+                        )
                     if on_progress is not None:
                         on_progress(query)
                     log_event(

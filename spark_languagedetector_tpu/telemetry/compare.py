@@ -7,8 +7,10 @@
 Turns the bench trajectory into an enforceable contract: capture A is the
 accepted baseline (a BENCH_r* run's JSONL, a CI artifact), capture B is
 the candidate; for every span path present in both, the wall-time
-percentiles (and fenced device totals, and the snapshot-carried
-fill/waste/stall histograms) are compared, and any metric that moved past
+percentiles (and fenced device totals, the snapshot-carried
+fill/waste/stall histograms, and the snapshot's recovery counters —
+retries, breaker trips, DLQ rows, degraded batches) are compared, and
+any metric that moved past
 ``--threshold`` (relative, in the *worse* direction — slower, less
 filled, more wasted) fails the run with exit code 1. Stages present in
 only one capture are reported but never fail the diff (instrumentation
@@ -20,6 +22,7 @@ zero-accelerator CI host against checked-in fixtures.
 
 from __future__ import annotations
 
+import math
 import sys
 
 from .registry import Histogram
@@ -67,6 +70,7 @@ def capture_stats(events: list[dict]) -> dict:
         stages[path] = entry
 
     hists: dict[str, dict] = {}
+    counters: dict[str, float] = {}
     for ev in events:
         if ev.get("event") != "telemetry.snapshot":
             continue
@@ -76,7 +80,21 @@ def capture_stats(events: list[dict]) -> dict:
                 str(k): v for k, v in payload.items()
                 if isinstance(v, dict) and v.get("count")
             }
-    return {"stages": stages, "histograms": hists}
+        # Recovery-behavior counters (retries, breaker trips, DLQ rows,
+        # degraded batches): a regression here is a reliability story even
+        # when every latency percentile held steady, so the guard diffs
+        # them like any other metric (docs/RESILIENCE.md §7).
+        cpayload = ev.get("counters")
+        if isinstance(cpayload, dict):
+            counters = {
+                str(k): v for k, v in cpayload.items()
+                if isinstance(v, (int, float))
+                and (
+                    str(k).startswith("resilience/")
+                    or str(k) in ("score/retries", "stream/retries")
+                )
+            }
+    return {"stages": stages, "histograms": hists, "counters": counters}
 
 
 def _rel_delta(base: float, new: float) -> float | None:
@@ -151,6 +169,33 @@ def compare_captures(
                     f"{name:<28} {m:<14} {b[m]:>12.6f} {n[m]:>12.6f} "
                     f"{delta:>+8.1%}{flag}"
                 )
+
+    b_c, n_c = base.get("counters", {}), new.get("counters", {})
+    for name in sorted(set(b_c) | set(n_c)):
+        bv = float(b_c.get(name, 0) or 0)
+        nv = float(n_c.get(name, 0) or 0)
+        if bv <= 0 and nv <= 0:
+            continue
+        if bv > 0:
+            delta = (nv - bv) / bv
+            shown = f"{delta:>+8.1%}"
+        else:
+            # Zero/absent baseline: the most common reliability regression
+            # IS a recovery counter appearing at all (0 retries -> 50, a
+            # first breaker trip) — a relative delta can't express it, so
+            # any appearance regresses regardless of threshold.
+            delta = math.inf
+            shown = f"{'new':>8}"
+        flag = ""
+        if delta > threshold:
+            flag = "  REGRESSION"
+            suffix = "new" if delta == math.inf else f"+{delta:.1%}"
+            regressions.append(f"{name}: {bv:g} -> {nv:g} ({suffix})")
+        if flag or abs(delta) > threshold / 2:
+            lines.append(
+                f"{name:<28} {'count':<14} {bv:>12.6f} "
+                f"{nv:>12.6f} {shown}{flag}"
+            )
 
     if only_base:
         lines.append(f"only in baseline: {', '.join(only_base)}")

@@ -79,6 +79,37 @@ def _tree_rows(stages: dict[str, Histogram]):
         yield label, path, stages.get(path)
 
 
+_RESILIENCE_COUNTERS = ("score/retries", "stream/retries")
+_RESILIENCE_GAUGES = (
+    "langdetect_breaker_state",
+    "langdetect_degraded",
+    "langdetect_dlq_rows",
+    "langdetect_retry_attempts",
+)
+
+
+def _resilience_summary(counters, gauges) -> list[str]:
+    """Rendered lines for the recovery-behavior block; [] when the capture
+    carries no resilience signals. Defensive like the other sections."""
+    out: list[str] = []
+    if isinstance(counters, dict):
+        for name in sorted(counters, key=str):
+            if (
+                str(name).startswith("resilience/")
+                or str(name) in _RESILIENCE_COUNTERS
+            ):
+                out.append(f"  {str(name):<40} {counters[name]}")
+    if isinstance(gauges, dict):
+        for name in _RESILIENCE_GAUGES:
+            series = gauges.get(name)
+            if not isinstance(series, dict):
+                continue
+            for labels in sorted(series, key=str):
+                tag = f"{name}{{{labels}}}" if labels else name
+                out.append(f"  {tag:<40} {series[labels]}")
+    return out
+
+
 def render_report(events: list[dict]) -> str:
     stages = aggregate_spans(events)
     lines: list[str] = []
@@ -150,6 +181,16 @@ def render_report(events: list[dict]) -> str:
                 lines.append("")
                 lines.append("gauges (last snapshot):")
                 lines.extend(rendered)
+        # Recovery-behavior highlight: the retry/breaker/DLQ/degraded
+        # counters and gauges pulled out of the generic sections, so a
+        # chaos run's (or an incident's) capture answers "did we degrade,
+        # how often did we retry, what got quarantined" at a glance
+        # (docs/RESILIENCE.md §7).
+        res = _resilience_summary(counters, gauges)
+        if res:
+            lines.append("")
+            lines.append("resilience (last snapshot):")
+            lines.extend(res)
     if not events:
         return "empty capture: no telemetry events"
     return "\n".join(lines)

@@ -1,0 +1,924 @@
+"""Resilience subsystem: policy/breaker units + seeded chaos property tests.
+
+The contract under test (ISSUE 3 acceptance criteria): under a seeded
+``FaultPlan`` injecting transient dispatch faults and one poison batch, a
+streaming run completes with outputs identical to the fault-free run, the
+poison rows land in the DLQ, the breaker opens and re-closes, and resuming
+from a checkpoint after a mid-stream kill re-emits no committed batch —
+all on CPU.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_languagedetector_tpu import LanguageDetectorModel
+from spark_languagedetector_tpu.api.runner import BatchRunner
+from spark_languagedetector_tpu.api.table import Table
+from spark_languagedetector_tpu.ops.vocab import EXACT, VocabSpec
+from spark_languagedetector_tpu.persist.checkpoint import (
+    load_checkpoint,
+    save_checkpoint,
+)
+from spark_languagedetector_tpu.resilience import faults
+from spark_languagedetector_tpu.resilience.dlq import DeadLetterQueue
+from spark_languagedetector_tpu.resilience.faults import (
+    FaultPlan,
+    InjectedFault,
+    PoisonRowError,
+    PoisonText,
+)
+from spark_languagedetector_tpu.resilience.policy import (
+    BreakerOpen,
+    CircuitBreaker,
+    DeadlineExceeded,
+    RetryPolicy,
+    is_retryable,
+)
+from spark_languagedetector_tpu.stream.microbatch import (
+    memory_source,
+    run_stream,
+)
+from spark_languagedetector_tpu.telemetry import REGISTRY
+
+
+def _fast_policy(**kw):
+    kw.setdefault("max_attempts", 2)
+    kw.setdefault("base_delay_s", 0.0)
+    return RetryPolicy(**kw)
+
+
+# ------------------------------------------------------ classifier unit -----
+def test_classifier_retryable_vs_deterministic():
+    assert is_retryable(RuntimeError("device lost"))
+    assert is_retryable(OSError("tunnel reset"))
+    assert is_retryable(TimeoutError("deadline"))
+    assert is_retryable(InjectedFault("chaos"))
+    assert not is_retryable(ValueError("bad column"))
+    assert not is_retryable(TypeError("bad type"))
+    assert not is_retryable(PoisonRowError("poison"))
+    # RuntimeError subclasses that are programming errors — the old bare
+    # (RuntimeError, OSError) tuple replayed both.
+    assert not is_retryable(NotImplementedError("todo"))
+    assert not is_retryable(RecursionError("loop"))
+    # BaseExceptions that aren't Exceptions are never retryable.
+    assert not is_retryable(KeyboardInterrupt())
+    assert not is_retryable(SystemExit(1))
+
+
+# ------------------------------------------------------ retry policy --------
+def test_backoff_deterministic_and_bounded():
+    p = RetryPolicy(base_delay_s=0.1, multiplier=2.0, max_delay_s=1.0,
+                    jitter=0.5, seed=7)
+    delays = [p.backoff_s(a) for a in range(1, 8)]
+    assert delays == [p.backoff_s(a) for a in range(1, 8)]  # deterministic
+    for a, d in enumerate(delays, start=1):
+        base = min(1.0, 0.1 * 2.0 ** (a - 1))
+        assert base * 0.5 <= d <= base
+    # A different seed jitters differently (same envelope).
+    other = RetryPolicy(base_delay_s=0.1, multiplier=2.0, max_delay_s=1.0,
+                        jitter=0.5, seed=8)
+    assert [other.backoff_s(a) for a in range(1, 8)] != delays
+    # jitter=0 is the pure exponential schedule.
+    flat = RetryPolicy(base_delay_s=0.1, multiplier=2.0, max_delay_s=1.0,
+                       jitter=0.0)
+    assert flat.backoff_s(1) == pytest.approx(0.1)
+    assert flat.backoff_s(4) == pytest.approx(0.8)
+    assert flat.backoff_s(10) == pytest.approx(1.0)  # capped
+
+
+def test_run_recovers_transient_and_reports_retries():
+    calls = {"n": 0}
+    seen = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise RuntimeError("transient")
+        return 42
+
+    slept = []
+    p = RetryPolicy(max_attempts=3, base_delay_s=0.01, jitter=0.0)
+    out = p.run(
+        flaky,
+        site="unit",
+        on_retry=lambda a, d, e: seen.append((a, d)),
+        sleep=slept.append,
+    )
+    assert out == 42 and calls["n"] == 3
+    assert [a for a, _ in seen] == [1, 2]
+    assert slept == [p.backoff_s(1), p.backoff_s(2)]
+
+
+def test_run_exhausts_attempts_then_raises():
+    p = _fast_policy(max_attempts=3)
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        p.run(always, site="unit")
+    assert calls["n"] == 3
+
+
+def test_run_deterministic_error_never_replayed():
+    calls = {"n": 0}
+
+    def bad():
+        calls["n"] += 1
+        raise ValueError("schema")
+
+    with pytest.raises(ValueError):
+        _fast_policy(max_attempts=5).run(bad, site="unit")
+    assert calls["n"] == 1
+
+
+def test_run_never_swallows_fatal_exceptions():
+    calls = {"n": 0}
+
+    def interrupted():
+        calls["n"] += 1
+        raise KeyboardInterrupt()
+
+    with pytest.raises(KeyboardInterrupt):
+        _fast_policy(max_attempts=5).run(interrupted, site="unit")
+    assert calls["n"] == 1
+
+
+def test_run_attempt_deadline_converts_to_deadline_exceeded():
+    p = RetryPolicy(max_attempts=5, base_delay_s=0.0,
+                    attempt_deadline_s=0.005)
+
+    def slow_fail():
+        time.sleep(0.02)
+        raise RuntimeError("slow transient")
+
+    with pytest.raises(DeadlineExceeded):
+        p.run(slow_fail, site="unit")
+
+
+def test_run_initial_error_counts_as_first_attempt():
+    # Replay-once policy: a failure the caller already observed (async
+    # fetch) leaves exactly one replay.
+    p = _fast_policy(max_attempts=2)
+    calls = {"n": 0}
+
+    def replay():
+        calls["n"] += 1
+        return "ok"
+
+    assert p.run(replay, initial_error=RuntimeError("x"), site="u") == "ok"
+    assert calls["n"] == 1
+    # max_attempts=1: the initial error already exhausted the budget.
+    with pytest.raises(RuntimeError):
+        _fast_policy(max_attempts=1).run(
+            replay, initial_error=RuntimeError("x"), site="u"
+        )
+    # A deterministic initial error propagates without any replay.
+    calls["n"] = 0
+    with pytest.raises(ValueError):
+        p.run(replay, initial_error=ValueError("x"), site="u")
+    assert calls["n"] == 0
+
+
+def test_from_env_reads_knobs(monkeypatch):
+    monkeypatch.setenv("LANGDETECT_RETRY_MAX_ATTEMPTS", "4")
+    monkeypatch.setenv("LANGDETECT_RETRY_BASE_DELAY_S", "0.25")
+    monkeypatch.setenv("LANGDETECT_RETRY_JITTER", "0")
+    monkeypatch.setenv("LANGDETECT_RETRY_ATTEMPT_DEADLINE_S", "9")
+    p = RetryPolicy.from_env()
+    assert p.max_attempts == 4
+    assert p.base_delay_s == 0.25
+    assert p.jitter == 0.0
+    assert p.attempt_deadline_s == 9.0
+    # Overrides win over the env.
+    assert RetryPolicy.from_env(max_attempts=1).max_attempts == 1
+
+
+# ------------------------------------------------------ circuit breaker -----
+def test_breaker_lifecycle_closed_open_halfopen_closed():
+    clk = {"t": 0.0}
+    br = CircuitBreaker(failure_threshold=2, cooldown_s=10.0,
+                        clock=lambda: clk["t"], name="unit")
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    assert br.state == "closed"  # one failure below threshold
+    br.record_failure()
+    assert br.state == "open"
+    assert not br.allow()  # cooldown not elapsed
+    clk["t"] = 11.0
+    assert br.allow()  # admits the probe
+    assert br.state == "half_open"
+    br.record_success()
+    assert br.state == "closed"
+    # Success resets the consecutive count: 1 failure + success + 1
+    # failure never trips a threshold-2 breaker.
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    assert br.state == "closed"
+
+
+def test_breaker_success_while_open_heals():
+    """A success landing while the breaker is OPEN (a retry inside one
+    policy run succeeding after the probe attempt re-opened it) is live
+    evidence the path works — it must heal the breaker, not strand a
+    proven-healthy path behind the next cooldown."""
+    clk = {"t": 0.0}
+    br = CircuitBreaker(failure_threshold=1, cooldown_s=5.0,
+                        clock=lambda: clk["t"])
+    br.record_failure()
+    assert br.state == "open"
+    br.record_success()
+    assert br.state == "closed"
+    # With a multi-probe breaker, one success only half-opens it.
+    br2 = CircuitBreaker(failure_threshold=1, cooldown_s=5.0,
+                         probe_successes=2, clock=lambda: clk["t"])
+    br2.record_failure()
+    br2.record_success()
+    assert br2.state == "half_open"
+    br2.record_success()
+    assert br2.state == "closed"
+
+
+def test_breaker_probe_failure_reopens():
+    clk = {"t": 0.0}
+    br = CircuitBreaker(failure_threshold=1, cooldown_s=5.0,
+                        clock=lambda: clk["t"])
+    br.record_failure()
+    assert br.state == "open"
+    clk["t"] = 6.0
+    assert br.allow()
+    br.record_failure()  # probe failed
+    assert br.state == "open"
+    assert not br.allow()  # cooldown restarted at t=6
+    clk["t"] = 12.0
+    assert br.allow()
+
+
+def test_breaker_state_gauge_exported():
+    REGISTRY.reset()
+    br = CircuitBreaker(failure_threshold=1, name="gaugetest")
+    br.record_failure()
+    series = REGISTRY.gauge_series()["langdetect_breaker_state"]
+    values = {tuple(sorted(l.items())): v for l, v in series}
+    assert values[(("breaker", "gaugetest"),)] == 2.0
+
+
+def test_policy_run_gated_by_open_breaker():
+    br = CircuitBreaker(failure_threshold=1, cooldown_s=1000.0)
+    br.record_failure()
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+
+    with pytest.raises(BreakerOpen):
+        _fast_policy().run(fn, site="u", breaker=br, breaker_gates=True)
+    assert calls["n"] == 0
+
+
+# ------------------------------------------------------ fault plan ----------
+def test_fault_plan_parse_grammar():
+    plan = FaultPlan.parse(
+        "seed=42;score/dispatch:error@2,5-7;score/fetch:delay=0.01@1;"
+        "stream/batch:poison=2@4;shard_step:error%0.25"
+    )
+    assert plan.seed == 42
+    kinds = {(s.site, s.kind) for s in plan.specs}
+    assert ("score/dispatch", "error") in kinds
+    assert ("stream/batch", "poison") in kinds
+    err = next(s for s in plan.specs if s.site == "score/dispatch")
+    assert err.calls == ((2, 2), (5, 7))
+    assert err.fires(6, plan.seed) and not err.fires(4, plan.seed)
+    poison = next(s for s in plan.specs if s.kind == "poison")
+    assert poison.value == 2.0
+    prob = next(s for s in plan.specs if s.prob is not None)
+    fires = [prob.fires(c, plan.seed) for c in range(1, 200)]
+    assert fires == [prob.fires(c, plan.seed) for c in range(1, 200)]
+    assert 0 < sum(fires) < 199  # fires sometimes, not always
+
+
+def test_fault_plan_prob_schedule_is_process_independent():
+    """%prob schedules must not depend on the builtin salted ``hash()``:
+    every process of a multi-host mesh (and every rerun) must fire on the
+    same calls. Pinned against the FNV-1a site hash — if this test starts
+    failing, the schedule just changed meaning for persisted plans."""
+    from spark_languagedetector_tpu.resilience.faults import _fnv1a
+
+    assert _fnv1a("shard_step") == 0x106C1B6B59E3862E
+    plan = FaultPlan.parse("seed=42;shard_step:error%0.3")
+    spec = plan.specs[0]
+    fired = [c for c in range(1, 21) if spec.fires(c, plan.seed)]
+    assert fired == [1, 3, 7, 8, 11, 17, 18, 19]
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "nosuchsite:error@1",
+        "score/dispatch:explode@1",
+        "score/dispatch:error@0",
+        "score/dispatch:error@3-1",
+        "score/dispatch:error@1%0.5",
+        "score/dispatch",
+    ],
+)
+def test_fault_plan_parse_rejects(bad):
+    with pytest.raises(ValueError):
+        FaultPlan.parse(bad)
+
+
+def test_inject_counts_calls_and_fires_deterministically():
+    with faults.plan_scope(FaultPlan.parse("score/dispatch:error@2")):
+        faults.inject("score/dispatch")  # call 1: clean
+        with pytest.raises(InjectedFault):
+            faults.inject("score/dispatch")  # call 2: fires
+        faults.inject("score/dispatch")  # call 3: clean again
+        faults.inject("score/fetch")  # other sites unaffected
+    faults.inject("score/dispatch")  # no plan: no-op
+
+
+def test_inject_delay_sleeps():
+    with faults.plan_scope(FaultPlan.parse("score/fetch:delay=0.02@1")):
+        t0 = time.perf_counter()
+        faults.inject("score/fetch")
+        assert time.perf_counter() - t0 >= 0.015
+
+
+def test_install_from_env(monkeypatch):
+    faults.uninstall()
+    monkeypatch.setenv("LANGDETECT_FAULT_PLAN", "seed=3;fit/count:error@1")
+    plan = faults.install_from_env()
+    assert plan is not None and plan.seed == 3
+    assert faults.active() is plan
+    faults.uninstall()
+    assert faults.active() is None
+    monkeypatch.setenv("LANGDETECT_FAULT_PLAN", "garbage")
+    with pytest.raises(ValueError):
+        faults.install_from_env()
+
+
+def test_corrupt_batch_poisons_deterministic_rows():
+    table = Table({"fulltext": [f"doc{i}" for i in range(6)], "k": range(6)})
+    plan = FaultPlan.parse("seed=5;stream/batch:poison=2@1")
+    with faults.plan_scope(plan):
+        out, rows = faults.corrupt_batch(table, "fulltext")
+    assert rows == plan.poison_rows(1, 6) and len(rows) == 2
+    for i in range(6):
+        v = out.column("fulltext")[i]
+        assert v == f"doc{i}"  # str value preserved (str subclass)
+        if i in rows:
+            assert isinstance(v, PoisonText)
+            with pytest.raises(PoisonRowError):
+                v.encode("utf-8")
+        else:
+            assert v.encode("utf-8") == f"doc{i}".encode()
+    # Untouched column and row count survive.
+    assert list(out.column("k")) == list(range(6))
+
+
+# ------------------------------------------------------ DLQ + checkpoint ----
+def test_dlq_records_and_jsonl_roundtrip(tmp_path):
+    path = str(tmp_path / "dead" / "letters.jsonl")
+    dlq = DeadLetterQueue(path)
+    dlq.put(batch=3, row_index=1, row={"fulltext": "bad"}, error="boom")
+    dlq.put(batch=4, row_index=0, row={"fulltext": "worse"}, error="boom2")
+    assert len(dlq) == 2
+    assert dlq.rows() == [{"fulltext": "bad"}, {"fulltext": "worse"}]
+    dlq.close()
+    records = DeadLetterQueue.load(path)
+    assert [r["batch"] for r in records] == [3, 4]
+    assert records[0]["row"] == {"fulltext": "bad"}
+    assert records[0]["event"] == "dlq.row"
+
+
+def test_checkpoint_atomic_roundtrip(tmp_path):
+    path = tmp_path / "ck" / "stream.json"
+    assert load_checkpoint(path) is None
+    save_checkpoint(path, {"committed": 5, "rows": 50})
+    state = load_checkpoint(path)
+    assert state["committed"] == 5 and state["rows"] == 50
+    assert state["version"] == 1 and "ts" in state
+    save_checkpoint(path, {"committed": 6})  # overwrite in place
+    assert load_checkpoint(path)["committed"] == 6
+    assert not path.with_name(path.name + ".tmp").exists()
+
+
+# ------------------------------------------------------ runner chaos --------
+def _runner(**kw):
+    spec = VocabSpec(EXACT, (1, 2))
+    rng = np.random.default_rng(3)
+    weights = rng.normal(size=(spec.id_space_size, 3)).astype(np.float32)
+    kw.setdefault("retry_policy", _fast_policy())
+    return BatchRunner(
+        weights=jnp.asarray(weights), lut=None, spec=spec,
+        batch_size=8, strategy="gather", **kw,
+    )
+
+
+def _docs(n=20, length=100):
+    rng = np.random.default_rng(5)
+    return [
+        bytes(rng.integers(0, 256, length, dtype=np.uint8)) for _ in range(n)
+    ]
+
+
+def test_runner_injected_dispatch_fault_recovers():
+    runner = _runner()
+    docs = _docs()
+    want = runner.score(docs)
+    with faults.plan_scope(FaultPlan.parse("score/dispatch:error@2")):
+        got = runner.score(docs)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    assert runner.metrics.snapshot()["counters"]["retries"] == 1
+    assert runner.breaker.state == "closed"  # one blip never trips
+
+
+def test_runner_injected_fetch_fault_replays():
+    runner = _runner()
+    docs = _docs()
+    want = runner.score(docs)
+    with faults.plan_scope(FaultPlan.parse("score/fetch:error@1")):
+        got = runner.score(docs)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    assert runner.metrics.snapshot()["counters"]["retries"] == 1
+
+
+def test_runner_injected_fault_label_path():
+    runner = _runner()
+    docs = _docs()
+    want = runner.predict_ids(docs)
+    with faults.plan_scope(
+        FaultPlan.parse("score/dispatch:error@1;score/fetch:error@2")
+    ):
+        got = runner.predict_ids(docs)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_runner_breaker_opens_degrades_and_recovers():
+    """The acceptance criterion's breaker leg: persistent dispatch faults
+    open the breaker, scoring continues exactly via the degradation
+    ladder (host level — the fast path IS the gather program here), and
+    once the faults stop the half-open probe re-closes the breaker."""
+    REGISTRY.reset()
+    clk = {"t": 0.0}
+    breaker = CircuitBreaker(failure_threshold=1, cooldown_s=10.0,
+                             clock=lambda: clk["t"], name="score")
+    runner = _runner(
+        retry_policy=_fast_policy(max_attempts=1), breaker=breaker
+    )
+    docs = _docs()
+    want = runner.score(docs)  # fault-free oracle (3 batches of 8/8/4)
+
+    with faults.plan_scope(FaultPlan.parse("score/dispatch:error@1")):
+        got = runner.score(docs)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        assert breaker.state == "open"
+        snap = REGISTRY.snapshot()
+        assert snap["counters"]["resilience/degraded_batches"] >= 1
+        assert snap["counters"]["resilience/breaker_opened"] == 1
+        # Batches after the trip skipped the fast path entirely.
+        assert snap["counters"]["resilience/breaker_short_circuit"] >= 1
+        gauges = REGISTRY.gauge_series()
+        assert gauges["langdetect_degraded"][0][1] == 1.0
+
+        # Still inside the plan scope (spec @1 is spent): cooldown elapses,
+        # the half-open probe succeeds, the breaker re-closes and scoring
+        # recovers to the fast path.
+        clk["t"] = 11.0
+        got2 = runner.score(docs)
+    np.testing.assert_allclose(got2, want, rtol=1e-6)
+    assert breaker.state == "closed"
+    assert REGISTRY.gauge_series()["langdetect_degraded"][0][1] == 0.0
+    assert runner.metrics.snapshot()["counters"]["degraded_batches"] >= 1
+
+
+def test_runner_degraded_ladder_from_fast_strategy():
+    """A pallas-family fast path degrades through the device-gather rung:
+    results stay exact because every rung computes the same scores."""
+    spec = VocabSpec(EXACT, (1, 2))
+    rng = np.random.default_rng(3)
+    weights = rng.normal(size=(spec.id_space_size, 3)).astype(np.float32)
+    docs = _docs(12)
+    oracle = BatchRunner(
+        weights=jnp.asarray(weights), lut=None, spec=spec,
+        batch_size=8, strategy="gather",
+    ).score(docs)
+
+    clk = {"t": 0.0}
+    runner = BatchRunner(
+        weights=jnp.asarray(weights), lut=None, spec=spec,
+        batch_size=8, strategy="pallas",
+        retry_policy=_fast_policy(max_attempts=1),
+        breaker=CircuitBreaker(failure_threshold=1, cooldown_s=1e9,
+                               clock=lambda: clk["t"]),
+    )
+    # Fail the pallas dispatch AND the ladder's device-gather rung (both
+    # count at score/dispatch): the host rung must carry the batch.
+    with faults.plan_scope(FaultPlan.parse("score/dispatch:error@1-2")):
+        got = runner.score(docs[:8])
+    np.testing.assert_allclose(got, np.asarray(oracle)[:8], rtol=1e-5)
+    snap = REGISTRY.snapshot()
+    assert snap["counters"].get("resilience/degraded_host", 0) >= 1
+
+
+def test_runner_deterministic_error_propagates_unretried(monkeypatch):
+    runner = _runner()
+    calls = {"n": 0}
+    orig = BatchRunner._dispatch_device
+
+    def bad(self, *a, **kw):
+        calls["n"] += 1
+        raise ValueError("programming error")
+
+    monkeypatch.setattr(BatchRunner, "_dispatch_device", bad)
+    with pytest.raises(ValueError):
+        runner.score(_docs(4))
+    assert calls["n"] == 1  # no futile replay, no fallback
+    monkeypatch.setattr(BatchRunner, "_dispatch_device", orig)
+
+
+# ------------------------------------------------------ stream chaos --------
+def _model():
+    return LanguageDetectorModel.from_gram_map(
+        {b"ab": [1.0, 0.0], b"xy": [0.0, 1.0]}, [2], ["a", "x"]
+    )
+
+
+def _stream_rows(n=40):
+    return [
+        {"fulltext": "ababab" if i % 2 == 0 else "xyxy"} for i in range(n)
+    ]
+
+
+def test_stream_chaos_matches_fault_free_oracle():
+    """THE acceptance test: transient stream + dispatch faults and one
+    poison batch; the query completes, output equals the fault-free run
+    minus exactly the poison rows, and those rows sit in the DLQ."""
+    rows = _stream_rows(40)
+    model = _model()
+    oracle: list[str] = []
+    run_stream(
+        model, memory_source(rows, 5),
+        sink=lambda t: oracle.extend(t.column("lang").tolist()),
+    )
+
+    plan = FaultPlan.parse(
+        "seed=11;stream/batch:error@2;score/dispatch:error@5;"
+        "stream/batch:poison=2@4"
+    )
+    poison_rows = plan.poison_rows(4, 5)  # row indices inside batch 4
+    assert len(poison_rows) == 2
+    outputs: list[str] = []
+    dlq = DeadLetterQueue()
+    model2 = _model()
+    with faults.plan_scope(plan):
+        query = run_stream(
+            model2,
+            memory_source(rows, 5),
+            sink=lambda t: outputs.extend(t.column("lang").tolist()),
+            retry_policy=_fast_policy(max_attempts=3),
+            dlq=dlq,
+        )
+
+    # The query never died: all 8 batches processed.
+    assert query.batches == 8
+    assert query.quarantined_batches == 1
+    assert query.dlq_rows == 2
+    # Output = oracle minus the poison rows (batch 4 == seq 3, rows 15-19).
+    poisoned_global = {15 + r for r in poison_rows}
+    expected = [
+        lang for i, lang in enumerate(oracle) if i not in poisoned_global
+    ]
+    assert outputs == expected
+    # The DLQ holds exactly the poison rows, with full context.
+    assert len(dlq) == 2
+    for record, r in zip(dlq.records, poison_rows):
+        assert record["batch"] == 3 and record["row_index"] == r
+        assert record["row"]["fulltext"] == rows[15 + r]["fulltext"]
+        assert "PoisonRowError" in record["error"]
+    # Transients were retried, not quarantined.
+    assert query.metrics.counters["retries"] >= 1
+
+
+def test_stream_deterministic_error_skips_replay_and_raises_without_dlq():
+    rows = _stream_rows(4)
+    model = _model()
+    calls = {"n": 0}
+    real = model.transform
+
+    def bad(batch):
+        calls["n"] += 1
+        raise ValueError("deterministic: bad column")
+
+    model.transform = bad
+    with pytest.raises(ValueError):
+        run_stream(
+            model, memory_source(rows, 2), sink=lambda t: None,
+            retry_policy=_fast_policy(max_attempts=4),
+        )
+    assert calls["n"] == 1  # straight out: no futile replay
+    model.transform = real
+
+
+def test_stream_deterministic_error_quarantines_with_dlq():
+    rows = _stream_rows(4)
+    model = _model()
+    real = model.transform
+
+    def flaky(batch):
+        # Only full batches (2 rows) fail: the bisect halves succeed, so
+        # nothing is actually poisoned — quarantine sinks everything.
+        if batch.num_rows > 1:
+            raise ValueError("batch-shaped deterministic failure")
+        return real(batch)
+
+    model.transform = flaky
+    outputs = []
+    dlq = DeadLetterQueue()
+    query = run_stream(
+        model, memory_source(rows, 2),
+        sink=lambda t: outputs.extend(t.column("lang").tolist()),
+        retry_policy=_fast_policy(max_attempts=2),
+        dlq=dlq,
+    )
+    model.transform = real
+    assert query.batches == 2
+    assert query.quarantined_batches == 2
+    assert len(dlq) == 0  # every row scored once isolated
+    assert outputs == ["a", "x", "a", "x"]
+
+
+def test_stream_bisect_outage_propagates_instead_of_quarantining():
+    """An outage striking mid-bisection is not poison: retryable failures
+    that exhaust the policy during isolation must crash the batch (it
+    replays whole on resume) rather than DLQ-ing healthy rows."""
+    rows = _stream_rows(4)
+    model = _model()
+    real = model.transform
+    state = {"batch_failed": False}
+
+    def flaky(batch):
+        if batch.num_rows > 1:
+            state["batch_failed"] = True
+            raise ValueError("deterministic batch failure")  # enter bisect
+        raise RuntimeError("device lost mid-bisection")  # outage
+
+    model.transform = flaky
+    dlq = DeadLetterQueue()
+    with pytest.raises(RuntimeError):
+        run_stream(
+            model, memory_source(rows, 2), sink=lambda t: None,
+            retry_policy=_fast_policy(max_attempts=2), dlq=dlq,
+        )
+    model.transform = real
+    assert state["batch_failed"]
+    assert len(dlq) == 0  # no healthy row was quarantined
+
+
+def test_stream_fatal_exceptions_never_swallowed():
+    rows = _stream_rows(4)
+    model = _model()
+    calls = {"n": 0}
+
+    def interrupted(batch):
+        calls["n"] += 1
+        raise KeyboardInterrupt()
+
+    model.transform = interrupted
+    with pytest.raises(KeyboardInterrupt):
+        run_stream(
+            model, memory_source(rows, 2), sink=lambda t: None,
+            retry_policy=_fast_policy(max_attempts=5),
+            dlq=DeadLetterQueue(),  # even the DLQ path must not absorb it
+        )
+    assert calls["n"] == 1
+
+
+def test_stream_checkpoint_commits_per_batch(tmp_path):
+    ck = str(tmp_path / "stream.ckpt")
+    rows = _stream_rows(12)
+    seen = []
+    run_stream(
+        _model(), memory_source(rows, 4),
+        sink=lambda t: seen.append(t.num_rows),
+        checkpoint_path=ck,
+    )
+    state = load_checkpoint(ck)
+    assert state["committed"] == 3
+    assert state["rows"] == 12
+
+
+def test_stream_checkpoint_resume_reemits_no_committed_batch(tmp_path):
+    """Mid-stream kill: the sink dies on the 4th batch; the resumed run
+    replays only the uncommitted tail, so each row is sunk exactly once
+    across the two runs (the acceptance criterion's resume leg)."""
+    ck = str(tmp_path / "stream.ckpt")
+    rows = _stream_rows(24)
+    model = _model()
+    oracle: list[str] = []
+    run_stream(
+        model, memory_source(rows, 4),
+        sink=lambda t: oracle.extend(t.column("lang").tolist()),
+    )
+
+    first_run: list[str] = []
+
+    def dying_sink(table):
+        if len(first_run) >= 12:  # batches 0-2 sunk, batch 3 kills
+            raise ValueError("sink crashed mid-stream")
+        first_run.extend(table.column("lang").tolist())
+
+    with pytest.raises(ValueError):
+        run_stream(
+            model, memory_source(rows, 4), sink=dying_sink,
+            checkpoint_path=ck,
+        )
+    assert load_checkpoint(ck)["committed"] == 3
+
+    second_run: list[str] = []
+    query = run_stream(
+        model, memory_source(rows, 4),
+        sink=lambda t: second_run.extend(t.column("lang").tolist()),
+        checkpoint_path=ck,
+    )
+    assert query.resumed_from == 3
+    assert query.batches == 3  # only the uncommitted tail
+    assert first_run + second_run == oracle  # exactly once, in order
+    assert load_checkpoint(ck)["committed"] == 6
+
+
+def test_stream_resume_with_chaos_and_dlq(tmp_path):
+    """Checkpoint + DLQ compose: a resumed run under a fault plan still
+    matches the oracle for everything it re-emits."""
+    ck = str(tmp_path / "stream.ckpt")
+    rows = _stream_rows(20)
+    model = _model()
+    oracle: list[str] = []
+    run_stream(
+        model, memory_source(rows, 5),
+        sink=lambda t: oracle.extend(t.column("lang").tolist()),
+    )
+    save_checkpoint(ck, {"committed": 2})  # batches 0-1 already sunk
+
+    outputs: list[str] = []
+    with faults.plan_scope(FaultPlan.parse("seed=2;stream/batch:error@1")):
+        query = run_stream(
+            model, memory_source(rows, 5),
+            sink=lambda t: outputs.extend(t.column("lang").tolist()),
+            retry_policy=_fast_policy(max_attempts=2),
+            checkpoint_path=ck,
+            dlq=DeadLetterQueue(),
+        )
+    assert query.resumed_from == 2 and query.batches == 2
+    assert outputs == oracle[10:]
+    assert query.metrics.counters["retries"] == 1
+
+
+# ------------------------------------------------------ fit + shard chaos ---
+def test_fit_recovers_from_injected_count_fault():
+    from spark_languagedetector_tpu import LanguageDetector
+
+    table = Table({
+        "lang": ["a", "x", "a", "x"],
+        "fulltext": ["abab", "xyxy", "abab", "xyxy"],
+    })
+    det = LanguageDetector(["a", "x"], [1, 2], 50)
+    want = det.fit(table).profile
+    with faults.plan_scope(FaultPlan.parse("fit/count:error@1")):
+        got = det.fit(table).profile
+    np.testing.assert_array_equal(got.ids, want.ids)
+    np.testing.assert_allclose(got.weights, want.weights, rtol=1e-12)
+
+
+def test_shard_step_fault_site(eight_devices):
+    from spark_languagedetector_tpu.ops.encoding import (
+        pad_batch,
+        texts_to_bytes,
+    )
+    from spark_languagedetector_tpu.ops.vocab import HASHED
+    from spark_languagedetector_tpu.parallel import mesh as mesh_lib
+    from spark_languagedetector_tpu.parallel import sharded as sharded_lib
+
+    mesh = mesh_lib.build_mesh(data=4, vocab=2)
+    spec = VocabSpec(HASHED, (1, 2), hash_bits=8)
+    fit_step = sharded_lib.make_sharded_fit_step(mesh, spec, 2)
+    batch, lengths = pad_batch(
+        texts_to_bytes(["abab", "bcbc", "xyxy", "zz"]), pad_to=8
+    )
+    lang_ids = np.asarray([0, 0, 1, 1], dtype=np.int32)
+    acc = jnp.zeros((spec.id_space_size, 2), dtype=jnp.int32)
+    with faults.plan_scope(FaultPlan.parse("shard_step:error@1")):
+        with pytest.raises(InjectedFault):
+            fit_step(batch, lengths, lang_ids, acc)
+        # The fault fired BEFORE any collective was enqueued, so the
+        # immediate replay (what the estimator-level policy does on every
+        # process) runs clean.
+        got = np.asarray(fit_step(batch, lengths, lang_ids, acc))
+    from spark_languagedetector_tpu.ops import fit_tpu
+
+    want = np.asarray(
+        fit_tpu.gram_counts_dense(
+            batch, lengths, lang_ids, spec=spec, num_langs=2
+        )
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+# ------------------------------------------------------ telemetry wiring ----
+def test_resilience_metrics_flow_through_registry_and_prometheus():
+    from spark_languagedetector_tpu.telemetry import render_prometheus
+
+    REGISTRY.reset()
+    runner = _runner()
+    docs = _docs(8)
+    with faults.plan_scope(FaultPlan.parse("score/dispatch:error@1")):
+        runner.score(docs)
+    snap = REGISTRY.snapshot()
+    assert snap["counters"]["resilience/retries"] >= 1
+    assert snap["counters"]["resilience/faults_injected"] >= 1
+    assert snap["histograms"]["resilience/retry_backoff_s"]["count"] >= 1
+    text = render_prometheus(REGISTRY)
+    assert 'langdetect_gauge{name="langdetect_retry_attempts"' in text
+    assert 'name="resilience/retries"' in text
+
+
+def test_report_cli_renders_resilience_section(tmp_path, capsys):
+    import json
+
+    from spark_languagedetector_tpu.telemetry.report import main as report_main
+
+    events = [
+        {"event": "telemetry.span", "ts": 1.0, "path": "score",
+         "wall_s": 0.5},
+        {"event": "telemetry.snapshot", "ts": 2.0,
+         "counters": {"resilience/retries": 3, "resilience/dlq_rows": 2,
+                      "resilience/breaker_opened": 1, "score/retries": 3},
+         "gauges": {"langdetect_breaker_state": {"breaker=score": 2.0},
+                    "langdetect_degraded": {"": 1.0}},
+         "histograms": {}},
+    ]
+    path = tmp_path / "cap.jsonl"
+    path.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+    assert report_main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "resilience" in out
+    assert "retries" in out and "breaker" in out
+
+
+def test_compare_flags_resilience_counter_regressions(tmp_path):
+    import json
+
+    from spark_languagedetector_tpu.telemetry.compare import main as cmp_main
+
+    def write(path, retries):
+        events = [
+            {"event": "telemetry.span", "ts": 1.0, "path": "score",
+             "wall_s": 0.5},
+            {"event": "telemetry.snapshot", "ts": 2.0,
+             "counters": {"resilience/retries": retries}, "gauges": {},
+             "histograms": {}},
+        ]
+        path.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    write(a, 2)
+    write(b, 20)
+    assert cmp_main([str(a), str(b), "--threshold", "0.5"]) == 1
+    write(b, 2)
+    assert cmp_main([str(a), str(b), "--threshold", "0.5"]) == 0
+    # Zero baseline: the counter *appearing* is the regression — a clean
+    # baseline (0 retries) vs a candidate that retries must fail.
+    write(a, 0)
+    write(b, 5)
+    assert cmp_main([str(a), str(b), "--threshold", "0.5"]) == 1
+    # ...and disappearing (5 -> 0) is an improvement, not a regression.
+    write(a, 5)
+    write(b, 0)
+    assert cmp_main([str(a), str(b), "--threshold", "0.5"]) == 0
+
+
+# ------------------------------------------------------ bench smoke ---------
+def test_bench_smoke_chaos_reports_recoveries(tmp_path):
+    import bench
+
+    jsonl = str(tmp_path / "chaos.jsonl")
+    result = bench.smoke_chaos(jsonl)
+    assert result["smoke_chaos"] is True
+    assert result["oracle_match"] is True
+    rec = result["recoveries"]
+    assert rec["retries"] >= 1
+    assert rec["dlq_rows"] >= 1
+    assert rec["breaker_opened"] >= 1
+    assert rec["degraded_batches"] >= 1
+    assert 0.0 <= result["degraded_time_share"] <= 1.0
+    assert result["telemetry"]["jsonl"] == jsonl
+    # The chaos capture renders through the stage-tree CLI like any other.
+    stages = result["telemetry"]["stages"]
+    assert any("degraded" in p for p in stages)

@@ -38,8 +38,9 @@ from ..ops.encoding import (
     bucket_length,
     chunk_document,
     pad_batch,
+    rows_under_byte_budget,
     truncate_utf8,
-    unpack_ragged,
+    unpack_ragged_jit,
 )
 from ..ops.vocab import VocabSpec
 from ..resilience import faults
@@ -57,16 +58,6 @@ _log = get_logger("api.runner")
 # RuntimeError subclasses that are programming errors
 # (NotImplementedError, RecursionError).
 RETRYABLE = (RuntimeError, OSError)
-
-# Device-side inverse of the ragged packer (ops.encoding.unpack_ragged),
-# jitted once per (flat-chunks, rows, pad_to) shape triple — all three are
-# bucketed, so the compile count stays bounded.
-from functools import partial as _partial
-
-
-@_partial(jax.jit, static_argnames=("pad_to",))
-def _unpack_ragged_jit(flat, offs, lengths, pad_to: int):
-    return unpack_ragged(flat, offs, lengths, pad_to)
 
 DEFAULT_BATCH_SIZE = 256
 # The fused pallas kernel keeps per-document state in VMEM scratch (no
@@ -106,11 +97,9 @@ def rows_for_bucket(pad_to: int, batch_size: int) -> int:
     the padded transfer fits MAX_BATCH_BYTES (64-row floor). The single
     policy site — `BatchRunner._execute` plans with it and `bench.py`'s
     compute-only measurement reuses it so the timed shape can't drift from
-    what the runner actually dispatches."""
-    rows = batch_size
-    while rows * pad_to > MAX_BATCH_BYTES and rows > 64:
-        rows //= 2
-    return rows
+    what the runner actually dispatches. The halving itself is the helper
+    shared with the fit pipeline (`ops.encoding.rows_under_byte_budget`)."""
+    return rows_under_byte_budget(pad_to, MAX_BATCH_BYTES, batch_size)
 
 
 def resolve_device(backend: str):
@@ -824,7 +813,9 @@ class BatchRunner:
         window_limit = (
             None if limit_np is None else jax.device_put(limit_np, placement)
         )
-        batch = _unpack_ragged_jit(flat, offs, lengths, pad_to)
+        # Shared jitted unpack (ops.encoding) — one compile cache with the
+        # fit pipeline's ragged ingest.
+        batch = unpack_ragged_jit(flat, offs, lengths, pad_to)
         return self._dispatch_device(batch, lengths, window_limit, placement)
 
     def _dispatch_device(self, batch, lengths, window_limit, placement):

@@ -102,6 +102,14 @@ class _DetectorParams(HasInputCol, HasLabelCol):
         "(micro-batched scatter-add + device weighting/top-k)",
         lambda v: v in ("cpu", "device"),
     )
+    fit_batch_rows = Param(
+        "fitBatchRows",
+        "device-fit micro-batch rows per count dispatch; None ⇒ rows adapt "
+        "per length bucket under a byte budget (LANGDETECT_FIT_BATCH_BYTES, "
+        "default 8MB per padded transfer; LANGDETECT_FIT_BATCH_ROWS forces "
+        "a fixed row count). Ignored by fitBackend='cpu'",
+        lambda v: v is None or _positive_int(v),
+    )
     backend = Param(
         "backend",
         "scoring backend stamped onto the fitted model "
@@ -136,6 +144,7 @@ class LanguageDetector(_DetectorParams):
             weightMode=fit_ops.PARITY,
             trainEncoding=UTF8,
             fitBackend="cpu",
+            fitBatchRows=None,
         )
         self.set("supportedLanguages", list(supported_languages))
         self.set("gramLengths", [int(n) for n in gram_lengths])
@@ -165,6 +174,9 @@ class LanguageDetector(_DetectorParams):
 
     def set_fit_backend(self, value: str):
         return self.set("fitBackend", value)
+
+    def set_fit_batch_rows(self, value: int | None):
+        return self.set("fitBatchRows", value)
 
     def set_backend(self, value: str):
         return self.set("backend", value)
@@ -316,6 +328,7 @@ class LanguageDetector(_DetectorParams):
                     spec,
                     self.get("languageProfileSize"),
                     self.get("weightMode"),
+                    batch_rows=self.get("fitBatchRows"),
                     mesh=mesh,
                 )
             return fit_profile_device(
@@ -325,6 +338,7 @@ class LanguageDetector(_DetectorParams):
                 spec,
                 self.get("languageProfileSize"),
                 self.get("weightMode"),
+                batch_rows=self.get("fitBatchRows"),
                 mesh=mesh,
             )
         return fit_ops.fit_profile_numpy(

@@ -151,6 +151,21 @@ def available() -> bool:
     return _load() is not None
 
 
+def default_pack_threads() -> int:
+    """Worker threads for the packing loops when the caller passes None —
+    min(8, cores), overridable via ``LANGDETECT_PACK_THREADS`` (e.g. to
+    leave cores free for a consumer thread pipelined against the packer,
+    or to pin single-threaded packing in latency-sensitive tests). One
+    policy site for both the padded and ragged loaders."""
+    raw = os.environ.get("LANGDETECT_PACK_THREADS")
+    if raw:
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            log_event(_log, "native.bad_pack_threads", value=raw)
+    return min(8, os.cpu_count() or 1)
+
+
 def pack_batch(
     byte_docs, pad_to: int, n_threads: int | None = None
 ) -> tuple[np.ndarray, np.ndarray]:
@@ -172,7 +187,7 @@ def pack_batch(
     out = np.empty((n, pad_to), dtype=np.uint8)
     out_lens = np.empty(n, dtype=np.int32)
     if n_threads is None:
-        n_threads = min(8, os.cpu_count() or 1)
+        n_threads = default_pack_threads()
     lib.pack_batch(
         ptrs,
         lens.ctypes.data_as(ctypes.c_void_p),
@@ -210,7 +225,7 @@ def pack_ragged(
             (len(d) for d in byte_docs), dtype=np.int64, count=n
         )
         if n_threads is None:
-            n_threads = min(8, os.cpu_count() or 1)
+            n_threads = default_pack_threads()
         lib.pack_ragged(
             ptrs,
             lens64.ctypes.data_as(ctypes.c_void_p),

@@ -69,6 +69,20 @@ DEFAULT_LENGTH_BUCKETS: tuple[int, ...] = (
 )
 
 
+def rows_under_byte_budget(
+    pad_to: int, byte_budget: int, max_rows: int, floor: int = 64
+) -> int:
+    """Micro-batch rows for a padded width: ``max_rows`` halved until the
+    padded transfer fits ``byte_budget``, never below ``floor``. The single
+    halving policy shared by the scoring runner (``MAX_BATCH_BYTES``) and
+    the fit pipeline (``LANGDETECT_FIT_BATCH_BYTES``), so the two paths'
+    compile-shape lattices can't drift."""
+    rows = max_rows
+    while rows * pad_to > byte_budget and rows > floor:
+        rows //= 2
+    return rows
+
+
 def pad_batch(
     byte_docs: Sequence[bytes],
     pad_to: int | None = None,
@@ -187,6 +201,29 @@ def unpack_ragged(flat, offs, lengths, pad_to: int):
     valid = j < -(-lengths[:, None] // RAGGED_CHUNK)
     idx = jnp.where(valid, offs[:, None] + j, 0)
     return flat[idx].reshape(lengths.shape[0], pad_to)
+
+
+# Shared jitted unpack: one compile cache per (C, B, S) shape triple for
+# every ragged consumer (the scoring runner's dispatch and the fit
+# pipeline's ingest), built lazily so importing this module never touches
+# jax. All three shapes are bucketed by the packers, so the compile count
+# stays bounded.
+_UNPACK_JIT = None
+
+
+def unpack_ragged_jit(flat, offs, lengths, pad_to: int):
+    """jit-compiled :func:`unpack_ragged` (``pad_to`` static), cached across
+    callers so the runner and the fit pipeline share compilations."""
+    global _UNPACK_JIT
+    if _UNPACK_JIT is None:
+        from functools import partial
+
+        import jax
+
+        _UNPACK_JIT = partial(jax.jit, static_argnames=("pad_to",))(
+            unpack_ragged
+        )
+    return _UNPACK_JIT(flat, offs, lengths, pad_to)
 
 
 def truncate_utf8(doc: bytes, cap: int) -> bytes:

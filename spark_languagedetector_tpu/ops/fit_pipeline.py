@@ -1,0 +1,398 @@
+"""Pipelined ingest for the device fit: plan → pack → put → count.
+
+Before this module, ``fit_profile_device`` walked the corpus with a fully
+serial host loop — Python slice, host ``pad_batch``, synchronous
+``jnp.asarray`` transfer, dispatch — using none of the wire machinery the
+scoring runner already had. BENCH_r05 measured the consequence:
+``fit_docs_per_s_device`` 666 vs 669 on host, on the same link where scoring
+runs 34k–165k docs/s. This module is the fit half catching up to the scoring
+half (docs/PERFORMANCE.md §6): the same data-parallel-counting shape DrJAX
+(arXiv:2403.07128) builds MapReduce primitives around, with the count-table
+reduction left to GSPMD (arXiv:2105.04663) exactly as the sharded fit step
+already does.
+
+Three pieces, all host-side policy (the count math stays in ``fit_tpu``):
+
+  * :func:`plan_fit_batches` — the deterministic micro-batch plan: oversized
+    documents (longer than the largest length bucket) are chunk-split onto
+    bucketed widths instead of rounding the padded width up per document
+    (which recompiled the count step per distinct width); the boundary
+    windows a split severs are counted on host and injected once through the
+    fit's ``extra_counts`` scatter, so the split is exactly count-preserving.
+    Items are length-sorted and grouped per length bucket with adaptive row
+    counts under a byte budget — the scoring runner's ``MAX_BATCH_BYTES``
+    discipline applied to fit, replacing the old hard-coded
+    ``batch_rows=512``.
+  * :func:`iter_device_batches` — a bounded producer/consumer pipeline: a
+    background packer thread packs each planned batch with the native packer
+    (``native/pack_batch`` / ``pack_ragged``), ships it ragged when the
+    chunk-aligned flat buffer is smaller than the padded form, and starts its
+    async ``device_put``, keeping :data:`FIT_PIPELINE_DEPTH` transferred
+    batches queued ahead of the jit count step that consumes them. The
+    consumer (the fit loop) therefore always has the next micro-batch
+    resident by the time the previous count dispatch returns.
+  * :func:`resolve_fit_batching` — the knob resolution: an explicit
+    ``batch_rows`` (estimator param ``fitBatchRows``) wins, then the
+    ``LANGDETECT_FIT_BATCH_ROWS`` env override, else adaptive sizing under
+    ``LANGDETECT_FIT_BATCH_BYTES`` (default 8MB per padded transfer).
+
+Exactness: the packed batches are bit-identical to ``pad_batch`` output (the
+ragged unpack reconstructs the same padded array on device), chunk-split plus
+the host-counted straddle windows reproduce every sliding window of every
+oversized document exactly once, and int32 count accumulation is
+order-independent — so the fitted profile stays bit-identical to the host
+fit (pinned by tests/test_fit_pipeline.py across single-device, split, and
+mesh paths).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Sequence
+
+import numpy as np
+
+from ..telemetry import REGISTRY, span
+from ..utils.logging import get_logger
+from .encoding import (
+    DEFAULT_LENGTH_BUCKETS,
+    RAGGED_CHUNK,
+    bucket_length,
+    round_chunks,
+    rows_under_byte_budget,
+)
+from .vocab import VocabSpec
+
+_log = get_logger("ops.fit_pipeline")
+
+ROWS_ENV = "LANGDETECT_FIT_BATCH_ROWS"
+BYTES_ENV = "LANGDETECT_FIT_BATCH_BYTES"
+
+# Byte budget for one micro-batch's padded transfer — the same wall the
+# scoring runner's MAX_BATCH_BYTES encodes (8MB batches beat both many
+# smaller puts and coarser-overlap 16MB ones on the tunneled link;
+# api/runner.py). Rows halve from the cap until the padded bytes fit, so
+# the compiled (rows, pad_to) set stays a small fixed lattice.
+DEFAULT_FIT_BATCH_BYTES = 8 << 20
+DEFAULT_FIT_MAX_ROWS = 4096
+MIN_FIT_ROWS = 64
+
+# Packed-and-transferring batches the producer keeps queued ahead of the
+# consumer. 2 keeps one batch packing and one in transfer while the count
+# step consumes a third — deeper buys nothing (the wire is serial) and
+# holds more device memory.
+FIT_PIPELINE_DEPTH = 2
+
+
+def _positive_env_int(name: str) -> int | None:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return None
+    try:
+        value = int(raw)
+    except ValueError as e:
+        raise ValueError(f"{name} must be an integer, got {raw!r}") from e
+    if value <= 0:
+        raise ValueError(f"{name} must be positive, got {value}")
+    return value
+
+
+def resolve_fit_batching(batch_rows: int | None = None) -> tuple[int | None, int]:
+    """(fixed_rows | None, byte_budget) for the fit's micro-batch plan.
+
+    An explicit ``batch_rows`` (the estimator's ``fitBatchRows`` param or a
+    direct ``fit_profile_device`` argument) wins; otherwise the
+    ``LANGDETECT_FIT_BATCH_ROWS`` env var forces a fixed row count; otherwise
+    rows adapt per length bucket under the ``LANGDETECT_FIT_BATCH_BYTES``
+    budget (default :data:`DEFAULT_FIT_BATCH_BYTES`).
+    """
+    budget = _positive_env_int(BYTES_ENV) or DEFAULT_FIT_BATCH_BYTES
+    if batch_rows is not None:
+        if batch_rows <= 0:
+            raise ValueError(f"batch_rows must be positive, got {batch_rows}")
+        return int(batch_rows), budget
+    return _positive_env_int(ROWS_ENV), budget
+
+
+def rows_for_fit_bucket(
+    pad_to: int,
+    byte_budget: int = DEFAULT_FIT_BATCH_BYTES,
+    max_rows: int = DEFAULT_FIT_MAX_ROWS,
+) -> int:
+    """Adaptive rows for a padded width — the fit twin of
+    ``api.runner.rows_for_bucket``, parameterized by budget so the env knob
+    reaches it. Both delegate to the shared halving policy."""
+    return rows_under_byte_budget(pad_to, byte_budget, max_rows, MIN_FIT_ROWS)
+
+
+def split_bounds(doc_len: int, max_len: int, min_tail: int) -> list[int]:
+    """Split positions for one oversized document: chunks of ``max_len``
+    with the final boundary pulled back so the tail chunk keeps at least
+    ``min_tail`` bytes (= the max gram length — a tail shorter than a gram
+    would trigger the partial-window rule the original long document never
+    takes). Every chunk length stays in [min_tail, max_len]."""
+    if doc_len <= max_len:
+        return []
+    bounds = list(range(max_len, doc_len, max_len))
+    if doc_len - bounds[-1] < min_tail:
+        bounds[-1] = doc_len - min_tail
+    return bounds
+
+
+def plan_fit_batches(
+    byte_docs: Sequence[bytes],
+    lang_indices,
+    spec: VocabSpec,
+    *,
+    batch_rows: int | None = None,
+    byte_budget: int = DEFAULT_FIT_BATCH_BYTES,
+    length_buckets: Sequence[int] = DEFAULT_LENGTH_BUCKETS,
+):
+    """Deterministic micro-batch plan for the device fit's ingest.
+
+    Returns ``(items, item_langs, plan, straddle)``:
+
+      * ``items`` / ``item_langs`` — the work rows: every document ≤ the
+        largest bucket verbatim, oversized documents chunk-split
+        (:func:`split_bounds`) with the chunk inheriting the doc's language;
+      * ``plan`` — ``[(sel int ndarray, pad_to), ...]``: row indices into
+        ``items`` plus the bucketed padded width. With ``batch_rows`` fixed,
+        sequential ``batch_rows``-row slices of the length-sorted order
+        (the historical shapes); adaptive mode groups per bucket with
+        :func:`rows_for_fit_bucket` rows, carrying each bucket's remainder
+        into the next wider bucket so the whole fit has at most one ragged
+        tail batch (the scoring planner's discipline). Every ``pad_to`` is a
+        member of ``length_buckets`` — chunk-splitting guarantees no
+        per-width recompiles.
+      * ``straddle`` — ``(ids, langs, counts)`` int64 arrays for the
+        boundary windows severed by chunk-splitting (host-computed via
+        ``spec.gram_to_id``), or None. Scatter-added once through the fit's
+        ``extra_counts`` path, they make the split exactly count-preserving.
+    """
+    max_len = length_buckets[-1]
+    max_gram = max(spec.gram_lengths)
+    lang_arr = np.asarray(lang_indices)
+    items: list[bytes] = []
+    item_langs: list[int] = []
+    corr: dict[tuple[int, int], int] = {}
+    for doc, lang in zip(byte_docs, lang_arr):
+        lang = int(lang)
+        if not isinstance(doc, bytes):
+            doc = bytes(doc)  # the native packer's c_char_p wants real bytes
+        if len(doc) <= max_len:
+            items.append(doc)
+            item_langs.append(lang)
+            continue
+        prev = 0
+        for p in split_bounds(len(doc), max_len, max_gram):
+            items.append(doc[prev:p])
+            item_langs.append(lang)
+            prev = p
+            # Windows straddling this boundary (start in (p-n, p)) exist in
+            # no chunk; count them here. n = 1 windows never straddle.
+            for n in spec.gram_lengths:
+                for s in range(p - n + 1, p):
+                    key = (spec.gram_to_id(doc[s : s + n]), lang)
+                    corr[key] = corr.get(key, 0) + 1
+        items.append(doc[prev:])
+        item_langs.append(lang)
+
+    langs_np = np.asarray(item_langs, dtype=np.int32)
+    order = np.argsort([len(d) for d in items], kind="stable")
+    plan: list[tuple[np.ndarray, int]] = []
+    if batch_rows is not None:
+        for start in range(0, len(order), batch_rows):
+            sel = order[start : start + batch_rows]
+            longest = max((len(items[i]) for i in sel), default=1)
+            plan.append(
+                (np.asarray(sel), bucket_length(max(longest, 1), length_buckets))
+            )
+    else:
+        by_bucket: dict[int, list[int]] = {}
+        for i in order:
+            b = bucket_length(len(items[i]) or 1, length_buckets)
+            by_bucket.setdefault(b, []).append(int(i))
+        carry: list[int] = []
+        for b in sorted(by_bucket):
+            idxs = carry + by_bucket[b]
+            rows = rows_for_fit_bucket(b, byte_budget)
+            full = len(idxs) - len(idxs) % rows
+            for start in range(0, full, rows):
+                plan.append((np.asarray(idxs[start : start + rows]), b))
+            carry = idxs[full:]
+        if carry:
+            b = bucket_length(
+                max(len(items[i]) for i in carry) or 1, length_buckets
+            )
+            rows = rows_for_fit_bucket(b, byte_budget)
+            for start in range(0, len(carry), rows):
+                plan.append((np.asarray(carry[start : start + rows]), b))
+
+    straddle = None
+    if corr:
+        e = np.asarray(
+            [(i, l, c) for (i, l), c in sorted(corr.items())], dtype=np.int64
+        )
+        straddle = (e[:, 0], e[:, 1], e[:, 2])
+    return items, langs_np, plan, straddle
+
+
+class _Failure:
+    __slots__ = ("error",)
+
+    def __init__(self, error: BaseException):
+        self.error = error
+
+
+_DONE = object()
+
+
+def iter_device_batches(
+    items: Sequence[bytes],
+    item_langs: np.ndarray,
+    plan,
+    *,
+    placement=None,
+    ragged: bool = True,
+    ndata: int = 1,
+    parent=None,
+    depth: int = FIT_PIPELINE_DEPTH,
+):
+    """Yield ``(batch, lengths, lang_ids, rows, pad_to)`` device operands for
+    every planned micro-batch, with packing and transfer pipelined ahead.
+
+    A background packer thread walks ``plan`` in order: native pack (ragged
+    when the chunk-aligned flat buffer beats the padded form — size precheck
+    identical to the scoring runner's), mesh row padding (``ndata`` > 1),
+    async ``device_put`` to ``placement``, then a bounded queue hand-off —
+    up to ``depth`` batches sit transferred-or-transferring beyond the one
+    the consumer holds, so the count step never waits on the host. Ragged
+    batches are rebuilt into the exact padded form on device by the shared
+    ``unpack_ragged_jit`` gather in the *consumer* thread, keeping every
+    compiled-program dispatch in deterministic plan order (multi-process
+    meshes require identical collective enqueue order on every process;
+    ``device_put`` of addressable shards is not a collective, but the puts
+    are plan-ordered too).
+
+    ``parent`` is the span the cross-thread ``fit/pack`` / ``fit/put`` spans
+    attach under (pass the ``fit/count`` span's parent so they become
+    siblings of ``fit/count``). Per-batch fill/padding-waste histograms and
+    the ``fit/wire_bytes`` counter are observed against the capacity that
+    actually rides the wire, mirroring the scoring path's bookkeeping.
+
+    Closing the generator (or a consumer exception) stops the producer and
+    drains the queue — a chaos-injected count fault leaves no packer thread
+    behind, so the estimator-level replay starts from a clean slate.
+    """
+    if not plan:
+        return
+    import jax
+
+    from .. import native
+    from .encoding import unpack_ragged_jit
+
+    native.available()  # one-time native build outside the pipelined loop
+    # Multi-process meshes: device_put of a NamedSharding spanning other
+    # processes' devices is not portable on this jax version — ship host
+    # arrays and let the pjit in_shardings place them at dispatch.
+    explicit_put = placement is None or jax.process_count() == 1
+    stop = threading.Event()
+    q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+
+    def pack_one(sel: np.ndarray, pad_to: int):
+        batch_docs = [items[k] for k in sel]
+        blangs = item_langs[sel]
+        if ndata > 1:
+            from ..parallel.mesh import pad_rows_for_mesh
+
+            batch_docs, blangs = pad_rows_for_mesh(batch_docs, ndata, (blangs, 0))
+        rows = len(batch_docs)
+        real_bytes = sum(len(d) for d in batch_docs)
+        use_ragged = False
+        flat_step = 0
+        total = 0
+        if ragged and pad_to % RAGGED_CHUNK == 0:
+            # Same precheck as the scoring runner: ragged only wins when the
+            # bucketed flat buffer is actually smaller than the padded batch.
+            flat_step = (rows * pad_to // RAGGED_CHUNK) // 16
+            total = 1 + sum(
+                -(-min(len(d), pad_to) // RAGGED_CHUNK) for d in batch_docs
+            )
+            use_ragged = (
+                round_chunks(total, flat_step) * RAGGED_CHUNK < rows * pad_to
+            )
+        if use_ragged:
+            capacity = round_chunks(total, flat_step) * RAGGED_CHUNK
+            with span("fit/pack", parent=parent, rows=rows, pad_to=pad_to,
+                      ragged=True):
+                host = native.pack_ragged(batch_docs, pad_to, flat_step=flat_step)
+            REGISTRY.incr("fit/ragged_batches")
+        else:
+            capacity = rows * pad_to
+            with span("fit/pack", parent=parent, rows=rows, pad_to=pad_to,
+                      ragged=False):
+                host = native.pack_batch(batch_docs, pad_to)
+        fill = real_bytes / capacity if capacity else 1.0
+        REGISTRY.observe("fit/batch_fill_ratio", fill)
+        REGISTRY.observe("fit/padding_waste", 1.0 - fill)
+        blangs = np.ascontiguousarray(blangs, dtype=np.int32)
+        REGISTRY.incr(
+            "fit/wire_bytes", sum(a.nbytes for a in host) + blangs.nbytes
+        )
+        if explicit_put:
+            # Async puts: they return immediately and the copies overlap the
+            # next batch's packing (and the consumer's count dispatch); the
+            # span fences them only under LANGDETECT_TELEMETRY_FENCE.
+            with span("fit/put", parent=parent, rows=rows, pad_to=pad_to) as sp:
+                dev = tuple(jax.device_put(a, placement) for a in host)
+                blangs_dev = jax.device_put(blangs, placement)
+                sp.fence(*dev)
+        else:
+            dev, blangs_dev = host, blangs
+        return (use_ragged, dev, blangs_dev, rows, pad_to)
+
+    def _offer(item) -> None:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return
+            except queue.Full:
+                continue
+
+    def producer():
+        try:
+            for sel, pad_to in plan:
+                if stop.is_set():
+                    return
+                _offer(pack_one(sel, pad_to))
+        except BaseException as e:  # surfaced to the consumer, never lost
+            _offer(_Failure(e))
+        else:
+            _offer(_DONE)
+
+    worker = threading.Thread(target=producer, name="fit-packer", daemon=True)
+    worker.start()
+    try:
+        while True:
+            got = q.get()
+            if got is _DONE:
+                break
+            if isinstance(got, _Failure):
+                raise got.error
+            use_ragged, dev, blangs_dev, rows, pad_to = got
+            if use_ragged:
+                flat, offs, lengths = dev
+                batch = unpack_ragged_jit(flat, offs, lengths, pad_to)
+            else:
+                batch, lengths = dev
+            yield batch, lengths, blangs_dev, rows, pad_to
+    finally:
+        stop.set()
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        worker.join(timeout=5.0)

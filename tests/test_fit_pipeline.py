@@ -1,0 +1,303 @@
+"""Pipelined device-fit ingest: planning, knobs, ragged transfer,
+oversized-doc chunk-splitting, and bit-identical parity with the host fit
+across the single-device, split, and mesh paths — including chaos replay
+with batches in flight (ISSUE 4)."""
+
+import numpy as np
+import pytest
+
+from spark_languagedetector_tpu import LanguageDetector, Table
+from spark_languagedetector_tpu.ops import fit_pipeline as fp
+from spark_languagedetector_tpu.ops.encoding import DEFAULT_LENGTH_BUCKETS
+from spark_languagedetector_tpu.ops.fit import COUNTS, PARITY, fit_profile_numpy
+from spark_languagedetector_tpu.ops.fit_tpu import (
+    fit_profile_device,
+    fit_profile_device_split,
+)
+from spark_languagedetector_tpu.ops.vocab import EXACT, HASHED, VocabSpec
+from spark_languagedetector_tpu.resilience import faults
+from spark_languagedetector_tpu.resilience.faults import FaultPlan, InjectedFault
+from spark_languagedetector_tpu.telemetry import REGISTRY
+
+MAX_BUCKET = DEFAULT_LENGTH_BUCKETS[-1]
+
+
+def _corpus(rng, n_docs, n_langs, max_len=120):
+    docs, langs = [], []
+    for i in range(n_docs):
+        ln = int(rng.integers(0, max_len))
+        docs.append(bytes(rng.integers(97, 105, ln, dtype=np.uint8)))
+        langs.append(i % n_langs)
+    return docs, np.asarray(langs)
+
+
+# ------------------------------------------------------------ planning -----
+def test_plan_adaptive_rows_respect_byte_budget():
+    rng = np.random.default_rng(7)
+    docs = [
+        bytes(rng.integers(97, 120, int(rng.integers(1, 4000)), dtype=np.uint8))
+        for _ in range(300)
+    ]
+    langs = np.arange(300) % 4
+    spec = VocabSpec(HASHED, (1, 2), hash_bits=10)
+    budget = 1 << 18  # 256KB: forces halving on the wide buckets
+    items, item_langs, plan, straddle = fp.plan_fit_batches(
+        docs, langs, spec, byte_budget=budget
+    )
+    assert straddle is None  # nothing oversized
+    covered = np.concatenate([sel for sel, _ in plan])
+    assert sorted(covered.tolist()) == list(range(len(items)))
+    for sel, pad_to in plan:
+        assert pad_to in DEFAULT_LENGTH_BUCKETS
+        assert max(len(items[i]) for i in sel) <= pad_to
+        # Budget honored unless already at the row floor.
+        assert len(sel) * pad_to <= budget or len(sel) <= fp.MIN_FIT_ROWS
+        assert len(sel) == fp.rows_for_fit_bucket(pad_to, budget) or (
+            sel is plan[-1][0]  # the single ragged tail batch
+        )
+
+
+def test_plan_fixed_rows_slices_sorted_order():
+    rng = np.random.default_rng(9)
+    docs, langs = _corpus(rng, 41, 3)
+    spec = VocabSpec(EXACT, (1, 2))
+    items, item_langs, plan, _ = fp.plan_fit_batches(
+        docs, langs, spec, batch_rows=16
+    )
+    assert [len(sel) for sel, _ in plan] == [16, 16, 9]
+    # Length-sorted walk: per-batch max length is non-decreasing.
+    maxes = [max(len(items[i]) for i in sel) for sel, _ in plan]
+    assert maxes == sorted(maxes)
+
+
+def test_resolve_fit_batching_env_overrides(monkeypatch):
+    monkeypatch.delenv(fp.ROWS_ENV, raising=False)
+    monkeypatch.delenv(fp.BYTES_ENV, raising=False)
+    assert fp.resolve_fit_batching(None) == (None, fp.DEFAULT_FIT_BATCH_BYTES)
+    assert fp.resolve_fit_batching(128) == (128, fp.DEFAULT_FIT_BATCH_BYTES)
+    monkeypatch.setenv(fp.ROWS_ENV, "32")
+    monkeypatch.setenv(fp.BYTES_ENV, str(1 << 20))
+    assert fp.resolve_fit_batching(None) == (32, 1 << 20)
+    # Explicit batch_rows beats the env row override.
+    assert fp.resolve_fit_batching(8) == (8, 1 << 20)
+    monkeypatch.setenv(fp.ROWS_ENV, "zero")
+    with pytest.raises(ValueError):
+        fp.resolve_fit_batching(None)
+    monkeypatch.setenv(fp.ROWS_ENV, "-4")
+    with pytest.raises(ValueError):
+        fp.resolve_fit_batching(None)
+
+
+def test_split_bounds_tail_never_shorter_than_gram():
+    for doc_len in (
+        MAX_BUCKET + 1,
+        MAX_BUCKET + 4,
+        2 * MAX_BUCKET,
+        2 * MAX_BUCKET + 1,
+        3 * MAX_BUCKET + 2,
+        20000,
+    ):
+        for min_tail in (2, 3, 5):
+            bounds = fp.split_bounds(doc_len, MAX_BUCKET, min_tail)
+            assert bounds, doc_len
+            edges = [0] + bounds + [doc_len]
+            sizes = [b - a for a, b in zip(edges, edges[1:])]
+            assert all(min_tail <= s <= MAX_BUCKET for s in sizes), (
+                doc_len, min_tail, sizes,
+            )
+    assert fp.split_bounds(MAX_BUCKET, MAX_BUCKET, 5) == []
+
+
+def test_plan_pins_compiled_shapes_for_oversized_docs():
+    """The recompile fix (ISSUE 4 satellite): oversized docs used to force a
+    per-distinct-width padded shape (-(-longest // 2048) * 2048); after
+    chunk-splitting every planned pad_to is a member of the bucket set, so
+    the compiled-shape lattice is closed."""
+    rng = np.random.default_rng(3)
+    docs, langs = _corpus(rng, 20, 3)
+    for extra in (9001, 12345, 20000, MAX_BUCKET + 1):
+        docs.append(bytes(rng.integers(97, 105, extra, dtype=np.uint8)))
+        langs = np.concatenate([langs, [0]])
+    spec = VocabSpec(HASHED, (1, 2, 3), hash_bits=12)
+    items, _, plan, straddle = fp.plan_fit_batches(docs, langs, spec)
+    assert all(pad_to in DEFAULT_LENGTH_BUCKETS for _, pad_to in plan)
+    assert max(len(it) for it in items) <= MAX_BUCKET
+    assert straddle is not None and straddle[2].sum() > 0
+
+
+# ------------------------------------------------- parity (single device) --
+@pytest.mark.parametrize("weight_mode", [PARITY, COUNTS])
+def test_oversized_docs_fit_parity(weight_mode):
+    """Chunk-split + straddle-window injection is exactly count-preserving:
+    the device fit of a corpus with documents far beyond the largest length
+    bucket stays bit-identical to the host fit."""
+    rng = np.random.default_rng(11)
+    docs, langs = _corpus(rng, 12, 3)
+    for ln, lang in ((9001, 0), (MAX_BUCKET + 1, 1), (20000, 2)):
+        docs.append(bytes(rng.integers(97, 105, ln, dtype=np.uint8)))
+        langs = np.concatenate([langs, [lang]])
+    for spec in (
+        VocabSpec(EXACT, (1, 2)),
+        VocabSpec(HASHED, (1, 2, 3), hash_bits=12),
+    ):
+        want_ids, want_w = fit_profile_numpy(
+            docs, langs, 3, spec, 40, weight_mode
+        )
+        got_ids, got_w = fit_profile_device(
+            docs, langs, 3, spec, 40, weight_mode
+        )
+        np.testing.assert_array_equal(got_ids, want_ids)
+        np.testing.assert_allclose(got_w, want_w, rtol=1e-6, atol=1e-7)
+
+
+def test_split_fit_oversized_doc_parity():
+    """Exact n=1..5 split fit with an oversized doc: the device half
+    chunk-splits (straddles counted for gram lengths <= 3), the host half
+    counts the original uncut documents — still bit-identical overall."""
+    rng = np.random.default_rng(13)
+    docs, langs = _corpus(rng, 20, 3, max_len=60)
+    docs += [b"", b"x", b"xy", b"wxyz"]
+    langs = np.concatenate([langs, [0, 1, 2, 0]])
+    docs.append(bytes(rng.integers(97, 103, 9000, dtype=np.uint8)))
+    langs = np.concatenate([langs, [1]])
+    spec = VocabSpec(EXACT, (1, 2, 3, 4, 5))
+    want_ids, want_w = fit_profile_numpy(docs, langs, 3, spec, 30, PARITY)
+    got_ids, got_w = fit_profile_device_split(docs, langs, 3, spec, 30, PARITY)
+    np.testing.assert_array_equal(got_ids, want_ids)
+    np.testing.assert_allclose(got_w, want_w, rtol=1e-6, atol=1e-7)
+
+
+def test_ragged_transfer_taken_and_parity():
+    """A sparse-fill batch (many short docs carried into a wide bucket) must
+    ride the ragged wire form — and stay bit-identical to the host fit."""
+    rng = np.random.default_rng(17)
+    docs = [
+        bytes(rng.integers(97, 105, int(rng.integers(20, 90)), dtype=np.uint8))
+        for _ in range(255)
+    ]
+    docs.append(bytes(rng.integers(97, 105, 600, dtype=np.uint8)))
+    langs = np.arange(256) % 3
+    spec = VocabSpec(EXACT, (1, 2))
+    before = REGISTRY.snapshot()["counters"].get("fit/ragged_batches", 0)
+    want_ids, want_w = fit_profile_numpy(docs, langs, 3, spec, 30, PARITY)
+    got_ids, got_w = fit_profile_device(docs, langs, 3, spec, 30, PARITY)
+    after = REGISTRY.snapshot()["counters"].get("fit/ragged_batches", 0)
+    assert after > before, "expected at least one ragged fit batch"
+    np.testing.assert_array_equal(got_ids, want_ids)
+    np.testing.assert_allclose(got_w, want_w, rtol=1e-6, atol=1e-7)
+
+
+def test_fit_telemetry_spans_and_histograms():
+    """Telemetry parity with the scoring path: fit/pack + fit/put spans and
+    batch fill / padding-waste histograms are recorded by the device fit."""
+    rng = np.random.default_rng(19)
+    docs, langs = _corpus(rng, 60, 3)
+    spec = VocabSpec(HASHED, (1, 2), hash_bits=10)
+    snap = REGISTRY.snapshot()["histograms"]
+    before = {
+        k: snap.get(k, {}).get("count", 0)
+        for k in ("span:fit/pack", "span:fit/put", "fit/batch_fill_ratio",
+                  "fit/padding_waste")
+    }
+    wire_before = REGISTRY.snapshot()["counters"].get("fit/wire_bytes", 0)
+    fit_profile_device(docs, langs, 3, spec, 25, PARITY, batch_rows=16)
+    snap = REGISTRY.snapshot()
+    for k, b in before.items():
+        assert snap["histograms"].get(k, {}).get("count", 0) > b, k
+    assert snap["counters"].get("fit/wire_bytes", 0) > wire_before
+    # Fill ratio is a fraction of the capacity that rode the wire.
+    fill = snap["histograms"]["fit/batch_fill_ratio"]
+    assert 0.0 < fill["max"] <= 1.0
+
+
+def test_estimator_fit_batch_rows_param_and_env(monkeypatch):
+    rows = {
+        "lang": ["de"] * 3 + ["en"] * 3,
+        "fulltext": [
+            "der schnelle braune fuchs",
+            "das ist ja sehr schön",
+            "noch ein deutscher satz",
+            "the quick brown fox",
+            "that is very nice",
+            "one more english sentence",
+        ],
+    }
+    det = lambda: LanguageDetector(["de", "en"], [1, 2], 100)  # noqa: E731
+    cpu = det().fit(Table(rows))
+    by_param = (
+        det().set_fit_backend("device").set_fit_batch_rows(2).fit(Table(rows))
+    )
+    np.testing.assert_array_equal(by_param.profile.ids, cpu.profile.ids)
+    np.testing.assert_allclose(
+        by_param.profile.weights, cpu.profile.weights, rtol=1e-6, atol=1e-7
+    )
+    monkeypatch.setenv(fp.ROWS_ENV, "3")
+    by_env = det().set_fit_backend("device").fit(Table(rows))
+    np.testing.assert_array_equal(by_env.profile.ids, cpu.profile.ids)
+    np.testing.assert_allclose(
+        by_env.profile.weights, cpu.profile.weights, rtol=1e-6, atol=1e-7
+    )
+
+
+# -------------------------------------------------------------- mesh -------
+def test_mesh_fit_pipeline_parity(eight_devices):
+    """The pipelined ingest feeds the sharded mesh fit step (row padding
+    folded into the packer thread) and the fitted profile stays
+    bit-identical to the host fit — row count deliberately not divisible by
+    the data axis."""
+    from spark_languagedetector_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.build_mesh(data=8, vocab=1)
+    rng = np.random.default_rng(23)
+    docs, langs = _corpus(rng, 37, 4)
+    docs += [b"", b"x"]
+    langs = np.concatenate([langs, [0, 1]])
+    spec = VocabSpec(HASHED, (1, 2, 3), hash_bits=11)
+    want_ids, want_w = fit_profile_numpy(docs, langs, 4, spec, 30, PARITY)
+    got_ids, got_w = fit_profile_device(
+        docs, langs, 4, spec, 30, PARITY, batch_rows=12, mesh=mesh
+    )
+    np.testing.assert_array_equal(got_ids, want_ids)
+    np.testing.assert_allclose(got_w, want_w, rtol=1e-6, atol=1e-7)
+
+
+# -------------------------------------------------------------- chaos ------
+def test_chaos_count_fault_with_batches_in_flight():
+    """An injected count-step fault with the pipeline running (several
+    batches packed/in flight) must propagate cleanly — no stuck packer
+    thread — and an immediate replay from fresh accumulators must be exact,
+    the property the estimator-level retry policy relies on."""
+    rng = np.random.default_rng(29)
+    docs, langs = _corpus(rng, 40, 3)
+    spec = VocabSpec(EXACT, (1, 2))
+    want_ids, want_w = fit_profile_device(
+        docs, langs, 3, spec, 25, PARITY, batch_rows=8
+    )
+    with faults.plan_scope(FaultPlan.parse("fit/count:error@2")):
+        with pytest.raises(InjectedFault):
+            fit_profile_device(docs, langs, 3, spec, 25, PARITY, batch_rows=8)
+        # Plan exhausted at call 2; the in-scope replay runs clean.
+        got_ids, got_w = fit_profile_device(
+            docs, langs, 3, spec, 25, PARITY, batch_rows=8
+        )
+    np.testing.assert_array_equal(got_ids, want_ids)
+    np.testing.assert_allclose(got_w, want_w, rtol=0, atol=0)
+
+
+def test_estimator_fit_replays_pipeline_fault():
+    """End to end: the env-tuned retry policy replays a chaos-injected
+    pipelined device fit and the fitted model equals the fault-free one."""
+    rows = {
+        "lang": ["a", "x"] * 6,
+        "fulltext": ["abab cdcd", "xyxy zwzw"] * 6,
+    }
+    det = lambda: (  # noqa: E731
+        LanguageDetector(["a", "x"], [1, 2], 50)
+        .set_fit_backend("device")
+        .set_fit_batch_rows(3)
+    )
+    want = det().fit(Table(rows)).profile
+    with faults.plan_scope(FaultPlan.parse("fit/count:error@2")):
+        got = det().fit(Table(rows)).profile
+    np.testing.assert_array_equal(got.ids, want.ids)
+    np.testing.assert_allclose(got.weights, want.weights, rtol=1e-12)

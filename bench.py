@@ -1046,6 +1046,226 @@ def smoke_chaos(jsonl_path: str | None = None) -> dict:
         REGISTRY.remove_sink(sink)
 
 
+def smoke_serve(jsonl_path: str | None = None) -> dict:
+    """CPU-safe serving smoke: the online subsystem under concurrent load.
+
+    Spins the whole serve stack up in-process — registry, continuous
+    batcher, threaded HTTP server — and drives it with concurrent
+    clients over a real socket, including one mid-run hot-swap (through
+    ``/admin/swap`` + the persist load path) and one shed burst against
+    a shrunken queue bound. Seconds, no accelerator.
+
+    Hard gates (``main()`` exits nonzero): every non-shed request must
+    be answered exactly once with scores bit-identical to the direct
+    ``BatchRunner.score`` of whichever model version served it
+    (``parity_ok``), zero requests may be dropped across the swap
+    (``dropped_responses``), the batcher must demonstrably coalesce
+    (``coalesced.mean_rows_per_dispatch > 1``), and the shed burst must
+    produce explicit 503 rejections (``shed.requests > 0``).
+    """
+    import tempfile
+    import threading
+
+    from spark_languagedetector_tpu import LanguageDetector, Table
+    from spark_languagedetector_tpu.ops.encoding import texts_to_bytes
+    from spark_languagedetector_tpu.serve import ContinuousBatcher, ModelRegistry
+    from spark_languagedetector_tpu.serve.client import ServeClient, ServeHTTPError
+    from spark_languagedetector_tpu.serve.server import ServingServer
+    from spark_languagedetector_tpu.telemetry import REGISTRY
+    from spark_languagedetector_tpu.telemetry.export import JsonlSink
+
+    REGISTRY.reset()
+    path = jsonl_path or os.path.join(
+        tempfile.gettempdir(), f"serve_smoke_{os.getpid()}.jsonl"
+    )
+    sink = JsonlSink(path)
+    REGISTRY.add_sink(sink)
+
+    # gram_lengths [1,2,3] keep the runner on the gather strategy (the
+    # batch-geometry-stable A/B reference), so the bit-exact parity gate
+    # below is strategy-sound, not geometry luck — a [1,2] profile would
+    # ride the onehot matmul, whose XLA reduction order may flip the last
+    # f32 bit between a request's solo geometry and its coalesced one
+    # (docs/SERVING.md §1).
+    langs = language_names(3)
+    docs, labels = make_corpus(langs, 60, mean_len=200, seed=3)
+    model_a = LanguageDetector(langs, [1, 2, 3], 200).fit(
+        Table({"lang": labels, "fulltext": docs})
+    )
+    docs_b, labels_b = make_corpus(langs, 60, mean_len=200, seed=9)
+    model_b = LanguageDetector(langs, [1, 2, 3], 150).fit(
+        Table({"lang": labels_b, "fulltext": docs_b})
+    )
+    runner_a, runner_b = model_a._get_runner(), model_b._get_runner()
+
+    registry = ModelRegistry()
+    v_a = registry.install(model_a)
+    batcher = ContinuousBatcher(
+        registry, max_wait_ms=5, max_rows=64, max_queue_rows=512
+    )
+    n_clients, rounds, docs_per_req = 6, 8, 4
+    barrier = threading.Barrier(n_clients)
+    results: list[tuple[list[str], np.ndarray, str, float]] = []
+    errors: list[str] = []
+    sheds = [0]
+    lock = threading.Lock()
+    swap_ms = [0.0]
+    v_b: list[str | None] = [None]
+    tmpdir = tempfile.mkdtemp(prefix="serve_smoke_model_")
+
+    with ServingServer(registry, port=0, batcher=batcher) as server:
+        host, port = server.address
+        client = ServeClient(host, port)
+
+        def drive(ci: int) -> None:
+            rng = np.random.default_rng(100 + ci)
+            for r in range(rounds):
+                try:
+                    barrier.wait(timeout=30)
+                except threading.BrokenBarrierError:
+                    pass
+                # Thread 0 swaps mid-run (between rounds, while the other
+                # five clients keep a request in flight every round).
+                if ci == 0 and r == rounds // 2:
+                    model_b.save(tmpdir + "/m")
+                    t0 = time.perf_counter()
+                    v_b[0] = client.swap(tmpdir + "/m")
+                    swap_ms[0] = (time.perf_counter() - t0) * 1e3
+                    continue
+                lo = int(rng.integers(0, len(docs) - docs_per_req))
+                texts = docs[lo:lo + docs_per_req]
+                t0 = time.perf_counter()
+                try:
+                    scores, meta = client.score(texts)
+                except ServeHTTPError as e:
+                    with lock:
+                        if e.shed:
+                            sheds[0] += 1
+                        else:
+                            errors.append(f"client {ci} round {r}: {e}")
+                    continue
+                latency_ms = (time.perf_counter() - t0) * 1e3
+                with lock:
+                    results.append(
+                        (texts, scores, meta["version"], latency_ms)
+                    )
+
+        threads = [
+            threading.Thread(target=drive, args=(ci,)) for ci in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        main_sheds = sheds[0]
+
+        # Shed burst: shrink the queue bound and fire concurrent bulk
+        # requests faster than the dispatcher drains — the overflow must
+        # come back as explicit 503s, never hangs.
+        batcher.max_queue_rows = 8
+        burst_answered = [0]
+
+        def burst(bi: int) -> None:
+            try:
+                scores, _ = client.score(
+                    docs[:docs_per_req], priority="bulk"
+                )
+            except ServeHTTPError as e:
+                with lock:
+                    if e.shed:
+                        sheds[0] += 1
+                    else:
+                        errors.append(f"burst {bi}: {e}")
+            else:
+                with lock:
+                    burst_answered[0] += 1
+
+        burst_threads = [
+            threading.Thread(target=burst, args=(bi,)) for bi in range(24)
+        ]
+        for t in burst_threads:
+            t.start()
+        for t in burst_threads:
+            t.join(timeout=60)
+        health = client.healthz()
+
+    # Parity: every answered request must match the direct runner of the
+    # version that served it, bit for bit (HTTP included).
+    parity_ok = not errors
+    for texts, scores, version, _ in results:
+        runner = runner_a if version == v_a else runner_b
+        want = runner.score(texts_to_bytes(texts))
+        if scores.shape != want.shape or not np.array_equal(scores, want):
+            parity_ok = False
+            errors.append(f"parity mismatch on version {version}")
+            break
+
+    expected_responses = n_clients * rounds - 1  # thread 0 spends one on swap
+    answered = len(results) + burst_answered[0]
+    dropped = expected_responses - len(results) - main_sheds
+    versions_served = sorted({v for _, _, v, _ in results})
+
+    snap = REGISTRY.snapshot()
+    hists = snap["histograms"]
+    rows_h = hists.get("serve/rows_per_dispatch", {})
+    total_h = hists.get("serve/total_s", {})
+    qwait_h = hists.get("serve/queue_wait_s", {})
+    total_requests = answered + sheds[0]
+    coalesced_mean = rows_h.get("mean", 0.0) / max(docs_per_req, 1)
+    result = {
+        "smoke_serve": True,
+        "requests": total_requests,
+        "answered": answered,
+        "dropped_responses": dropped,
+        "parity_ok": parity_ok,
+        "errors": errors[:5],
+        "latency_ms": {
+            "p50": round(total_h.get("p50", 0.0) * 1e3, 3),
+            "p99": round(total_h.get("p99", 0.0) * 1e3, 3),
+            "queue_wait_p99": round(qwait_h.get("p99", 0.0) * 1e3, 3),
+        },
+        "coalesced": {
+            "dispatches": rows_h.get("count", 0),
+            "mean_rows_per_dispatch": round(rows_h.get("mean", 0.0), 3),
+            "mean_requests_per_dispatch": round(coalesced_mean, 3),
+            "max_rows_per_dispatch": rows_h.get("max", 0),
+            "rows": snap["counters"].get("serve/coalesced_rows", 0),
+            "histogram": rows_h,
+        },
+        "shed": {
+            "requests": sheds[0],
+            "rate": round(sheds[0] / max(total_requests, 1), 4),
+            "burst_answered": burst_answered[0],
+        },
+        "swap": {
+            "from": v_a,
+            "to": v_b[0],
+            "wall_ms": round(swap_ms[0], 3),
+            "versions_served": versions_served,
+        },
+        "health": {
+            "version": health.get("version"),
+            "breaker": health.get("breaker"),
+        },
+        "telemetry": telemetry_block(path),
+    }
+    result["ok"] = bool(
+        parity_ok
+        and dropped == 0
+        # Both coalescing signals: rows (the acceptance bar) AND
+        # requests per dispatch — the latter is what actually proves
+        # coalescing, since every request already carries
+        # docs_per_req rows on its own.
+        and result["coalesced"]["mean_rows_per_dispatch"] > 1.0
+        and result["coalesced"]["mean_requests_per_dispatch"] > 1.0
+        and sheds[0] > 0
+        and v_b[0] is not None
+        and len(versions_served) >= 2
+    )
+    REGISTRY.remove_sink(sink)
+    return result
+
+
 # ------------------------------------------------------------ per config ----
 CONFIGS = {
     # cap: ship maxScoreBytes=256 on the headline config — language identity
@@ -1673,6 +1893,33 @@ def main():
         if not result["oracle_match"]:
             print(
                 "chaos smoke FAILED: " + "; ".join(result["mismatches"]),
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        return
+    if "--smoke-serve" in sys.argv[1:]:
+        # Serving smoke path: in-process HTTP server + concurrent clients,
+        # one mid-run hot-swap, one shed burst. Gates: bit-exact parity
+        # per served version, zero dropped responses, demonstrated
+        # coalescing, explicit shed rejections.
+        args = [a for a in sys.argv[1:] if a != "--smoke-serve"]
+        flags = [a for a in args if a.startswith("-")]
+        if flags or len(args) > 1:
+            print(
+                f"usage: python bench.py --smoke-serve [out.jsonl] "
+                f"(got {args})",
+                file=sys.stderr,
+            )
+            sys.exit(2)
+        result = smoke_serve(args[0] if args else None)
+        print(json.dumps(result), flush=True)
+        if not result["ok"]:
+            print(
+                "serve smoke FAILED: "
+                + (
+                    "; ".join(result["errors"])
+                    or "gate (parity/dropped/coalescing/shed) not met"
+                ),
                 file=sys.stderr,
             )
             sys.exit(1)

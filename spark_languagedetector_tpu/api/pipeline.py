@@ -29,6 +29,7 @@ directory.
 from __future__ import annotations
 
 import json
+import re
 import shutil
 import time
 from pathlib import Path
@@ -149,8 +150,6 @@ class PipelineModel(Identifiable):
 
 def _load_pipeline_dir(root: Path, expected_class: str):
     """(metadata, reconstructed stages) for a saved pipeline directory."""
-    import os
-
     meta = _read_metadata(root)
     if meta.get("class") != expected_class:
         raise ValueError(
@@ -162,9 +161,14 @@ def _load_pipeline_dir(root: Path, expected_class: str):
         cls = _import_stage_class(info["class"])
         # The dir name comes from the metadata file — confine it to a
         # direct child of stages/ (same trust boundary as the class
-        # check above).
+        # check above). Allowlist, not denylist: the empty string,
+        # backslashes (a separator on Windows), and anything outside
+        # [A-Za-z0-9._-] are rejected along with "." / "..".
         dir_name = info["dir"]
-        if os.sep in dir_name or dir_name in ("..", ".") or "/" in dir_name:
+        if (
+            not re.fullmatch(r"[A-Za-z0-9._-]+", dir_name)
+            or dir_name in ("..", ".")
+        ):
             raise ValueError(
                 f"refusing stage directory name {dir_name!r}: must be a "
                 "plain name under stages/"
@@ -226,47 +230,70 @@ class _PipelineModelWriter:
             shutil.rmtree(tmp)
         tmp.mkdir(parents=True)
         try:
-            stage_info = []
-            for i, stage in enumerate(self._model.stages):
-                cls = type(stage)
-                cls_name = f"{cls.__module__}.{cls.__qualname__}"
-                writable = hasattr(stage, "write")
-                dir_name = f"{i:02d}_{stage.uid}"
-                sdir = tmp / "stages" / dir_name
-                if writable:
-                    sdir.parent.mkdir(parents=True, exist_ok=True)
-                    stage.write().save(str(sdir))
-                else:
-                    if not hasattr(stage, "param_metadata"):
-                        raise TypeError(
-                            f"pipeline stage {stage!r} has neither write() "
-                            "nor params — cannot persist it"
-                        )
-                    _write_metadata(
-                        sdir,
-                        {
-                            "class": cls_name,
-                            "uid": stage.uid,
-                            "timestamp": int(time.time() * 1000),
-                            "paramMap": stage.param_metadata(),
-                        },
-                    )
-                stage_info.append(
-                    {"class": cls_name, "uid": stage.uid, "dir": dir_name,
-                     "writable": writable}
-                )
-            _write_metadata(
-                tmp,
-                {
-                    "class": self._class_name,
-                    "uid": self._model.uid,
-                    "timestamp": int(time.time() * 1000),
-                    "stages": stage_info,
-                },
-            )
-            if root.exists():  # re-checked: the swap is last and quick
-                shutil.rmtree(root)
+            self._write_tree(tmp)
+        except BaseException:
+            # Mid-build failure: nothing was swapped, the old save (if
+            # any) is untouched — only the partial temp tree goes.
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        # Swap phase: at every instant at least one complete save exists
+        # on disk. The old root is renamed aside (atomic, same parent),
+        # the new tree replaces it, and the old tree is deleted only
+        # after the replace succeeded. A failed replace restores the old
+        # root and leaves ``tmp`` on disk — the new save must not be
+        # destroyed just because the rename failed.
+        backup = None
+        if root.exists():
+            backup = root.parent / f".{root.name}.old.{os.getpid()}"
+            if backup.exists():
+                shutil.rmtree(backup)
+            os.replace(root, backup)
+        try:
             os.replace(tmp, root)
-        finally:
-            if tmp.exists():
-                shutil.rmtree(tmp)
+        except BaseException:
+            if backup is not None:
+                os.replace(backup, root)
+            raise
+        if backup is not None:
+            shutil.rmtree(backup)
+
+    def _write_tree(self, tmp: Path) -> None:
+        """Write the stage tree + pipeline metadata under ``tmp``."""
+        stage_info = []
+        for i, stage in enumerate(self._model.stages):
+            cls = type(stage)
+            cls_name = f"{cls.__module__}.{cls.__qualname__}"
+            writable = hasattr(stage, "write")
+            dir_name = f"{i:02d}_{stage.uid}"
+            sdir = tmp / "stages" / dir_name
+            if writable:
+                sdir.parent.mkdir(parents=True, exist_ok=True)
+                stage.write().save(str(sdir))
+            else:
+                if not hasattr(stage, "param_metadata"):
+                    raise TypeError(
+                        f"pipeline stage {stage!r} has neither write() "
+                        "nor params — cannot persist it"
+                    )
+                _write_metadata(
+                    sdir,
+                    {
+                        "class": cls_name,
+                        "uid": stage.uid,
+                        "timestamp": int(time.time() * 1000),
+                        "paramMap": stage.param_metadata(),
+                    },
+                )
+            stage_info.append(
+                {"class": cls_name, "uid": stage.uid, "dir": dir_name,
+                 "writable": writable}
+            )
+        _write_metadata(
+            tmp,
+            {
+                "class": self._class_name,
+                "uid": self._model.uid,
+                "timestamp": int(time.time() * 1000),
+                "stages": stage_info,
+            },
+        )

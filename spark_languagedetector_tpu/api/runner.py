@@ -34,7 +34,9 @@ from ..ops import score_hist
 from ..ops import score_pallas
 from ..ops.encoding import (
     DEFAULT_LENGTH_BUCKETS,
+    ENCODINGS,
     RAGGED_CHUNK,
+    UTF8,
     bucket_length,
     chunk_document,
     pad_batch,
@@ -182,6 +184,17 @@ class BatchRunner:
 
     One runner per (profile, config); reuse it across calls to amortize
     compilation.
+
+    Concurrent-caller contract (the online batcher and any threaded host
+    rely on it, pinned by ``tests/test_serve.py``): ``score`` /
+    ``predict_ids`` may be called from any number of threads at once on a
+    single-device runner and return results bit-identical to serial
+    calls. Each call plans, packs, and scatters into its own local state;
+    the lazily-built shared caches (pallas/hybrid/hist/host state, the
+    window-limit cache) are guarded by ``_state_lock``; metrics and the
+    telemetry registry lock internally. Multi-process meshes are the
+    exception — their collective schedule requires one call at a time,
+    process-wide.
     """
 
     weights: jnp.ndarray
@@ -225,6 +238,13 @@ class BatchRunner:
     # accuracy cost — the wire is the binding wall for short-gram configs
     # (docs/PERFORMANCE.md §1).
     max_score_bytes: int | None = None
+    # How the caller produced the byte docs (ops.encoding.ENCODINGS). Only
+    # the truncation semantics of max_score_bytes depend on it: UTF-8 docs
+    # back the cap off continuation bytes so no character is split, but in
+    # low_byte docs 0x80-0xBF are ordinary characters — treating them as
+    # continuations could back the cap off arbitrarily far below
+    # max_score_bytes, so non-UTF-8 docs take a hard byte slice instead.
+    score_encoding: str = UTF8
     # Failure handling (docs/RESILIENCE.md). ``retry_policy`` replays
     # transient dispatch/fetch failures with backoff (None ⇒ the env-tuned
     # default: replay-once). ``breaker`` trips after consecutive device
@@ -296,6 +316,11 @@ class BatchRunner:
             if placement is not None:
                 entries = jax.device_put(entries, placement)
             self._cuckoo_entries = entries
+        if self.score_encoding not in ENCODINGS:
+            raise ValueError(
+                f"unknown score_encoding {self.score_encoding!r}; expected "
+                f"one of {ENCODINGS}"
+            )
         if self.strategy not in (
             "auto", "gather", "onehot", "pallas", "hybrid", "hist"
         ):
@@ -1045,9 +1070,11 @@ class BatchRunner:
 
     def _execute_traced(self, byte_docs: Sequence[bytes], *, want_labels: bool):
         if self.max_score_bytes:
-            byte_docs = [
-                truncate_utf8(d, self.max_score_bytes) for d in byte_docs
-            ]
+            cap = self.max_score_bytes
+            if self.score_encoding == UTF8:
+                byte_docs = [truncate_utf8(d, cap) for d in byte_docs]
+            else:
+                byte_docs = [d[:cap] for d in byte_docs]
         N = len(byte_docs)
         L = self.weights.shape[1]
         if want_labels:

@@ -541,6 +541,11 @@ class LanguageDetectorModel(HasInputCol, HasOutputCol):
                     ),
                     mesh=mesh,
                     max_score_bytes=self.get("maxScoreBytes"),
+                    # maxScoreBytes truncation must know how the docs were
+                    # encoded: low_byte docs take a hard slice (bytes in
+                    # 0x80-0xBF are characters there, not UTF-8
+                    # continuations the cap should back off).
+                    score_encoding=self.get("predictEncoding"),
                 )
             return self._runner
 

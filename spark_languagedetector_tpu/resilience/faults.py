@@ -41,6 +41,12 @@ Sites (one hook per serving layer; docs/RESILIENCE.md §4):
   * ``fit/count``      — the fit count stage (host pass or each device
     count step).
   * ``shard_step``     — each sharded-mesh fit step.
+  * ``serve/admit``    — the online batcher's admission gate
+    (:meth:`serve.batcher.ContinuousBatcher.submit`): a firing ``error``
+    is converted into a shed (the request is rejected with
+    :class:`~..serve.batcher.ServeOverloaded`, exactly like a full
+    queue), so chaos plans drive the load-shedding and hot-swap paths
+    deterministically on CPU.
 """
 
 from __future__ import annotations
@@ -65,6 +71,7 @@ SITES = (
     "stream/batch",
     "fit/count",
     "shard_step",
+    "serve/admit",
 )
 
 KINDS = ("error", "delay", "poison")

@@ -1,0 +1,59 @@
+"""Online serving subsystem: continuous batching, hot-swap, load shedding.
+
+The offline layers score pre-assembled lists; this package serves many
+concurrent small requests through the same compiled dispatch
+(docs/SERVING.md):
+
+  * :class:`~.batcher.ContinuousBatcher` — admission queue + coalescing
+    dispatcher on the runner's shape lattice, with priority lanes,
+    per-request deadlines, and SLO-aware load shedding;
+  * :class:`~.registry.ModelRegistry` — versioned models with pre-warmed
+    zero-downtime hot-swap and rollback;
+  * :class:`~.server.ServingServer` / :class:`~.client.ServeClient` —
+    stdlib-only JSON-over-HTTP front end and client.
+
+Importing this package never initializes jax — runners are built by the
+models the registry loads.
+"""
+
+from __future__ import annotations
+
+from .batcher import (
+    BULK,
+    INTERACTIVE,
+    LANES,
+    ContinuousBatcher,
+    ServeClosed,
+    ServeDeadlineExceeded,
+    ServeError,
+    ServeOverloaded,
+    ServeResult,
+)
+from .registry import ModelRegistry, ModelVersion
+
+__all__ = [
+    "BULK",
+    "INTERACTIVE",
+    "LANES",
+    "ContinuousBatcher",
+    "ModelRegistry",
+    "ModelVersion",
+    "ServeClosed",
+    "ServeDeadlineExceeded",
+    "ServeError",
+    "ServeOverloaded",
+    "ServeResult",
+]
+
+
+def __getattr__(name):
+    # The HTTP halves import lazily so `import ...serve` stays light.
+    if name in ("ServingServer",):
+        from .server import ServingServer
+
+        return ServingServer
+    if name in ("ServeClient", "ServeHTTPError"):
+        from . import client
+
+        return getattr(client, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

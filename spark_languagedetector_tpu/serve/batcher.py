@@ -1,0 +1,576 @@
+"""Continuous micro-batcher: concurrent small requests → coalesced dispatches.
+
+Every entry point before this module was offline: ``BatchRunner.score``
+takes one pre-assembled list, ``run_stream`` pulls from one source. Online
+serving is the inverse shape — many concurrent callers, each with a handful
+of documents, all wanting low latency. The pjit/TPUv4 serving lesson
+(PAPERS.md: Yoo et al., arXiv:2204.06514) is that throughput lives or dies
+on keeping one resident compiled program fed with coalesced batches on a
+closed shape lattice. The runner already maintains that lattice (bucketed
+[B, S] shapes, ragged transfers); this module supplies the admission queue
+in front of it:
+
+  * requests are admitted into priority lanes (``interactive`` ahead of
+    ``bulk``) and coalesced into one ``BatchRunner.score``/``predict_ids``
+    call by a single dispatcher thread — a flush fires when the queue
+    reaches ``max_rows`` or the oldest admitted request has waited
+    ``max_wait_ms`` (env ``LANGDETECT_SERVE_MAX_ROWS`` /
+    ``LANGDETECT_SERVE_MAX_WAIT_MS``);
+  * demux is deterministic: each request's rows come back as a contiguous
+    slice of the coalesced result — the batcher adds no numeric step of
+    its own, so responses are bit-identical to calling the runner
+    directly with the same documents on every batch-geometry-stable
+    strategy (``gather``/the runner's A/B reference — pinned by
+    ``tests/test_serve.py``; matmul-based strategies can differ in the
+    final f32 bit across coalesce geometries, the reduction-order class
+    documented in ARCHITECTURE.md, with labels exact throughout);
+  * backpressure is explicit: the queue is bounded (rows), an estimated
+    wait past the SLO sheds, and breaker-open / degraded-ladder states
+    shed the bulk lane — shed requests fail fast with
+    :class:`ServeOverloaded` (the HTTP front end maps it to 503), never
+    hang. The ``serve/admit`` fault site lets chaos plans force sheds
+    deterministically.
+
+Model hot-swap composes through the source: the dispatcher leases the
+serving runner per dispatch (see :mod:`.registry`), so a swap lands
+between dispatches and every request is answered by exactly one version.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import numpy as np
+
+from ..resilience import faults
+from ..telemetry import REGISTRY, current_trace_id, new_trace_id, span, trace_request
+from ..utils.logging import get_logger, log_event
+
+_log = get_logger("serve.batcher")
+
+# Priority lanes, drained in this order: a bulk backlog must never add
+# queueing delay to an interactive request.
+INTERACTIVE = "interactive"
+BULK = "bulk"
+LANES = (INTERACTIVE, BULK)
+
+# Env knobs (docs/SERVING.md §3); explicit ctor args win.
+MAX_WAIT_ENV = "LANGDETECT_SERVE_MAX_WAIT_MS"
+MAX_ROWS_ENV = "LANGDETECT_SERVE_MAX_ROWS"
+QUEUE_ROWS_ENV = "LANGDETECT_SERVE_QUEUE_ROWS"
+SLO_MS_ENV = "LANGDETECT_SERVE_SLO_MS"
+
+DEFAULT_MAX_WAIT_MS = 10.0
+DEFAULT_MAX_ROWS = 256
+DEFAULT_QUEUE_ROWS = 4096
+DEFAULT_SLO_MS = 0.0  # 0 ⇒ estimated-wait shedding off
+
+
+class ServeError(RuntimeError):
+    """Base class for serving-layer failures."""
+
+
+class ServeOverloaded(ServeError):
+    """Request shed at admission (queue full, SLO blown, degraded bulk,
+    or an injected ``serve/admit`` fault). Maps to HTTP 503."""
+
+    def __init__(self, message: str, *, reason: str = "overloaded",
+                 retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+class ServeDeadlineExceeded(ServeError):
+    """The request's deadline passed while it was still queued — rejected
+    explicitly instead of burning device time on a dead response. Maps to
+    HTTP 504."""
+
+
+class ServeClosed(ServeError):
+    """Submitted to a batcher that has been closed."""
+
+
+def _env_float(key: str, default: float) -> float:
+    try:
+        return float(os.environ.get(key, "") or default)
+    except ValueError:
+        return default
+
+
+@dataclass
+class ServeResult:
+    """One request's demuxed response.
+
+    ``values`` is the request's contiguous slice of the coalesced result:
+    float32 ``[N, L]`` scores, or int32 ``[N]`` argmax ids in label mode.
+    """
+
+    values: np.ndarray
+    version: str
+    trace_id: str
+    queue_wait_s: float
+    dispatch_s: float
+    languages: tuple[str, ...] | None = None
+
+    @property
+    def scores(self) -> np.ndarray:
+        return self.values
+
+    @property
+    def labels(self) -> list[str]:
+        if self.languages is None:
+            raise ServeError("serving source carries no language names")
+        return [self.languages[int(i)] for i in self.values]
+
+
+@dataclass
+class _Request:
+    docs: list[bytes]
+    want_labels: bool
+    priority: str
+    deadline: float | None  # absolute time.monotonic()
+    trace_id: str
+    admitted_at: float
+    future: Future = field(default_factory=Future)
+
+
+class _StaticSource:
+    """Adapter presenting a bare :class:`~..api.runner.BatchRunner` through
+    the registry's lease protocol (version pinned to ``"v0"``)."""
+
+    class _Entry:
+        __slots__ = ("runner", "version", "languages", "model")
+
+        def __init__(self, runner):
+            self.runner = runner
+            self.version = "v0"
+            self.languages = None
+            self.model = None
+
+    def __init__(self, runner):
+        self._entry = self._Entry(runner)
+
+    def peek(self):
+        return self._entry
+
+    def lease(self):
+        from contextlib import nullcontext
+
+        return nullcontext(self._entry)
+
+
+class ContinuousBatcher:
+    """SLO-aware continuous batcher in front of a runner (or registry).
+
+    ``source`` is either a :class:`~..api.runner.BatchRunner` or anything
+    with the registry lease protocol (``peek()`` and ``lease()`` yielding
+    an entry with ``runner``/``version``/``languages`` — see
+    :class:`~.registry.ModelRegistry`). One dispatcher thread owns all
+    device work, so concurrent callers never race the runner.
+    """
+
+    def __init__(
+        self,
+        source,
+        *,
+        max_wait_ms: float | None = None,
+        max_rows: int | None = None,
+        max_queue_rows: int | None = None,
+        slo_ms: float | None = None,
+        shed_bulk_when_degraded: bool = True,
+        name: str = "serve",
+    ):
+        if not hasattr(source, "lease"):
+            source = _StaticSource(source)
+        self._source = source
+        self.max_wait_s = (
+            max_wait_ms if max_wait_ms is not None
+            else _env_float(MAX_WAIT_ENV, DEFAULT_MAX_WAIT_MS)
+        ) / 1000.0
+        self.max_rows = int(
+            max_rows if max_rows is not None
+            else _env_float(MAX_ROWS_ENV, DEFAULT_MAX_ROWS)
+        )
+        self.max_queue_rows = int(
+            max_queue_rows if max_queue_rows is not None
+            else _env_float(QUEUE_ROWS_ENV, DEFAULT_QUEUE_ROWS)
+        )
+        self.slo_s = (
+            slo_ms if slo_ms is not None
+            else _env_float(SLO_MS_ENV, DEFAULT_SLO_MS)
+        ) / 1000.0
+        if self.max_rows < 1 or self.max_queue_rows < 1:
+            raise ValueError("max_rows and max_queue_rows must be >= 1")
+        self.shed_bulk_when_degraded = shed_bulk_when_degraded
+        self.name = name
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._lanes: dict[str, deque[_Request]] = {p: deque() for p in LANES}
+        self._queued_rows = 0
+        self._inflight_rows = 0
+        # Rows/s over recent dispatches (EMA): the estimated-wait shed
+        # signal. Zero until the first dispatch lands.
+        self._ema_rows_per_s = 0.0
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name=f"{name}-batcher", daemon=True
+        )
+        self._thread.start()
+        log_event(
+            _log, "serve.batcher.start", max_wait_ms=self.max_wait_s * 1e3,
+            max_rows=self.max_rows, max_queue_rows=self.max_queue_rows,
+            slo_ms=self.slo_s * 1e3,
+        )
+
+    # ------------------------------------------------------- admission ------
+    def submit(
+        self,
+        byte_docs: Sequence[bytes],
+        *,
+        priority: str = INTERACTIVE,
+        want_labels: bool = False,
+        deadline_ms: float | None = None,
+        trace_id: str | None = None,
+    ) -> Future:
+        """Admit one request; returns a Future resolving to a
+        :class:`ServeResult` (or raising the dispatch error).
+
+        Raises :class:`ServeOverloaded` immediately when the request is
+        shed — admission control fails fast so callers can retry
+        elsewhere instead of queueing into a blown SLO.
+        """
+        if priority not in LANES:
+            raise ValueError(
+                f"unknown priority {priority!r}; expected one of {LANES}"
+            )
+        docs = list(byte_docs)
+        # Chaos gate: an injected error here IS a shed — same counters,
+        # same exception shape — so chaos plans exercise the rejection
+        # path deterministically (docs/RESILIENCE.md §4).
+        try:
+            faults.inject("serve/admit")
+        except faults.InjectedFault as e:
+            self._count_shed(len(docs), "injected", priority)
+            raise ServeOverloaded(
+                "admission rejected (injected fault)", reason="injected",
+                retry_after_s=self.max_wait_s,
+            ) from e
+        tid = trace_id or current_trace_id() or new_trace_id()
+        if not docs:
+            if self._closed:
+                raise ServeClosed(f"batcher {self.name!r} is closed")
+            # Zero-row requests never wake the row-counting dispatcher;
+            # answer them at admission with the empty result the runner
+            # itself would return (score([]) is [0, L]).
+            entry = self._source.peek()
+            L = getattr(getattr(entry, "runner", None), "weights", None)
+            L = 0 if L is None else int(L.shape[1])
+            fut: Future = Future()
+            fut.set_result(ServeResult(
+                values=(
+                    np.zeros(0, np.int32) if want_labels
+                    else np.zeros((0, L), np.float32)
+                ),
+                version=entry.version,
+                trace_id=tid,
+                queue_wait_s=0.0,
+                dispatch_s=0.0,
+                languages=getattr(entry, "languages", None),
+            ))
+            REGISTRY.incr("serve/admitted_requests")
+            REGISTRY.incr("serve/requests")
+            return fut
+        now = time.monotonic()
+        req = _Request(
+            docs=docs,
+            want_labels=want_labels,
+            priority=priority,
+            deadline=None if deadline_ms is None else now + deadline_ms / 1e3,
+            trace_id=tid,
+            admitted_at=now,
+        )
+        with self._cv:
+            if self._closed:
+                raise ServeClosed(f"batcher {self.name!r} is closed")
+            reason, wait_s = self._shed_reason_locked(len(docs), priority)
+            if reason is not None:
+                self._count_shed(len(docs), reason, priority)
+                raise ServeOverloaded(
+                    f"request shed ({reason}): {self._queued_rows} rows "
+                    f"queued, estimated wait {wait_s * 1e3:.1f}ms",
+                    reason=reason,
+                    retry_after_s=max(wait_s, self.max_wait_s),
+                )
+            self._lanes[priority].append(req)
+            self._queued_rows += len(docs)
+            self._set_queue_gauges_locked()
+            self._cv.notify_all()
+        REGISTRY.incr("serve/admitted_requests")
+        return req.future
+
+    def score(self, byte_docs: Sequence[bytes], **kw) -> np.ndarray:
+        """Blocking convenience: admit + wait; float32 [N, L] scores."""
+        return self.submit(byte_docs, **kw).result().values
+
+    def predict_ids(self, byte_docs: Sequence[bytes], **kw) -> np.ndarray:
+        """Blocking convenience: admit + wait; int32 [N] argmax ids."""
+        return self.submit(byte_docs, want_labels=True, **kw).result().values
+
+    def _shed_reason_locked(
+        self, rows: int, priority: str
+    ) -> tuple[str | None, float]:
+        """(shed reason or None, estimated wait seconds). Caller holds
+        the lock. Reject-newest: the request being admitted is the one
+        shed — already-queued work is never evicted."""
+        backlog = self._queued_rows + self._inflight_rows
+        wait_s = (
+            backlog / self._ema_rows_per_s if self._ema_rows_per_s > 0 else 0.0
+        )
+        if self._queued_rows + rows > self.max_queue_rows:
+            return "queue_full", wait_s
+        if self.slo_s > 0 and wait_s > self.slo_s:
+            return "slo", wait_s
+        if priority == BULK and self.shed_bulk_when_degraded:
+            entry = self._source.peek()
+            runner = getattr(entry, "runner", None)
+            breaker = getattr(runner, "breaker", None)
+            state = breaker.state if breaker is not None else "closed"
+            if state == "open" or getattr(runner, "_degraded_mode", False):
+                return "degraded", wait_s
+        return None, wait_s
+
+    def _count_shed(self, rows: int, reason: str, priority: str) -> None:
+        REGISTRY.incr("serve/shed_requests")
+        REGISTRY.incr("serve/shed_rows", rows)
+        REGISTRY.incr(f"serve/shed_{reason}")
+        log_event(
+            _log, "serve.shed", reason=reason, rows=rows, priority=priority,
+            queued_rows=self._queued_rows, trace_id=current_trace_id(),
+        )
+
+    def _set_queue_gauges_locked(self) -> None:
+        depth = sum(len(lane) for lane in self._lanes.values())
+        REGISTRY.set_gauge("langdetect_serve_queue_depth", depth)
+        REGISTRY.set_gauge("langdetect_serve_queue_rows", self._queued_rows)
+
+    # ------------------------------------------------------- dispatcher -----
+    @staticmethod
+    def _complete(req: _Request, result=None, error: Exception | None = None):
+        """Resolve one request's future, tolerating caller-side cancels.
+
+        A client may cancel() its pending future (its own timeout) while
+        the request is queued; set_result on a cancelled future raises
+        InvalidStateError, and an exception here would kill the one
+        dispatcher thread and hang every later request — the worst
+        possible failure mode for this module. Cancelled requests are
+        simply dropped (their caller stopped listening)."""
+        try:
+            if error is not None:
+                req.future.set_exception(error)
+            else:
+                req.future.set_result(result)
+        except BaseException:
+            REGISTRY.incr("serve/cancelled_requests")
+
+    def _oldest_locked(self) -> float | None:
+        ages = [
+            lane[0].admitted_at for lane in self._lanes.values() if lane
+        ]
+        return min(ages) if ages else None
+
+    def _take_locked(self) -> list[_Request]:
+        """Pop one coalesced batch: interactive lane first, then bulk,
+        whole requests only, until ``max_rows`` is reached (the first
+        request is always taken, even when larger than ``max_rows``).
+        All requests in a batch share one result mode — a mode flip at a
+        lane front ends the batch there (it leads the next one), so the
+        demux below stays a pure offset walk."""
+        batch: list[_Request] = []
+        rows = 0
+        want_labels: bool | None = None
+        for lane in LANES:
+            q = self._lanes[lane]
+            while q and (rows < self.max_rows or not batch):
+                if want_labels is not None and q[0].want_labels != want_labels:
+                    break
+                req = q.popleft()
+                want_labels = req.want_labels
+                batch.append(req)
+                rows += len(req.docs)
+        self._queued_rows -= rows
+        self._inflight_rows = rows
+        self._set_queue_gauges_locked()
+        return batch
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while self._queued_rows == 0 and not self._closed:
+                    self._cv.wait()
+                if self._queued_rows == 0 and self._closed:
+                    return
+                # Coalescing window: hold the flush until max_rows are
+                # queued or the oldest request has waited max_wait — the
+                # micro-batch analog of Nagle, bounded by the SLO knob.
+                while self._queued_rows < self.max_rows:
+                    oldest = self._oldest_locked()
+                    if oldest is None:
+                        break
+                    remaining = oldest + self.max_wait_s - time.monotonic()
+                    if remaining <= 0 or self._closed:
+                        break
+                    self._cv.wait(remaining)
+                if self._queued_rows == 0:
+                    continue
+                batch = self._take_locked()
+            try:
+                self._dispatch(batch)
+            except Exception as e:  # safety net: the thread must survive
+                log_event(_log, "serve.dispatcher_error", error=repr(e))
+                for req in batch:
+                    self._complete(req, error=ServeError(
+                        f"internal dispatcher error: {e!r}"
+                    ))
+            finally:
+                with self._cv:
+                    self._inflight_rows = 0
+                    self._cv.notify_all()
+
+    def _dispatch(self, batch: list[_Request]) -> None:
+        t_start = time.monotonic()
+        live: list[_Request] = []
+        for req in batch:
+            if req.future.cancelled():
+                # The caller gave up while the request was queued: don't
+                # spend device time on a response nobody will read.
+                REGISTRY.incr("serve/cancelled_requests")
+            elif req.deadline is not None and t_start > req.deadline:
+                REGISTRY.incr("serve/deadline_rejects")
+                log_event(
+                    _log, "serve.deadline", trace_id=req.trace_id,
+                    rows=len(req.docs),
+                    waited_ms=(t_start - req.admitted_at) * 1e3,
+                )
+                self._complete(req, error=ServeDeadlineExceeded(
+                    f"deadline passed after {t_start - req.admitted_at:.3f}s "
+                    "in queue"
+                ))
+            else:
+                live.append(req)
+        if not live:
+            return
+        rows = sum(len(r.docs) for r in live)
+        docs = [d for r in live for d in r.docs]
+        want_labels = live[0].want_labels
+        REGISTRY.set_gauge("langdetect_serve_inflight_rows", rows)
+        try:
+            with self._source.lease() as entry:
+                # The lead request's trace id is the dispatch's ambient
+                # trace (the runner's score span joins it); every
+                # coalesced request keeps its own id on its result and in
+                # the serve.dispatch event, so one slow request is
+                # greppable end to end.
+                with trace_request(live[0].trace_id), span(
+                    "serve/dispatch", rows=rows, requests=len(live),
+                    version=entry.version, labels=want_labels,
+                ):
+                    t0 = time.perf_counter()
+                    if want_labels:
+                        out = entry.runner.predict_ids(docs)
+                    else:
+                        out = entry.runner.score(docs)
+                    dispatch_s = time.perf_counter() - t0
+        except Exception as e:
+            REGISTRY.incr("serve/dispatch_errors")
+            log_event(
+                _log, "serve.dispatch_error", rows=rows,
+                requests=len(live), error=repr(e),
+            )
+            for req in live:
+                self._complete(req, error=e)
+            return
+        finally:
+            REGISTRY.set_gauge("langdetect_serve_inflight_rows", 0)
+        # Telemetry: the coalescing evidence (counter + per-dispatch
+        # distribution) and the three per-request latency legs.
+        REGISTRY.incr("serve/dispatches")
+        REGISTRY.incr("serve/requests", len(live))
+        REGISTRY.incr("serve/coalesced_rows", rows)
+        REGISTRY.observe("serve/rows_per_dispatch", rows)
+        REGISTRY.observe("serve/requests_per_dispatch", len(live))
+        REGISTRY.observe("serve/dispatch_s", dispatch_s)
+        if dispatch_s > 0:
+            rate = rows / dispatch_s
+            self._ema_rows_per_s = (
+                rate if self._ema_rows_per_s == 0.0
+                else 0.7 * self._ema_rows_per_s + 0.3 * rate
+            )
+        done = time.monotonic()
+        off = 0
+        for req in live:
+            sub = np.array(out[off:off + len(req.docs)])
+            off += len(req.docs)
+            queue_wait_s = t_start - req.admitted_at
+            REGISTRY.observe("serve/queue_wait_s", queue_wait_s)
+            REGISTRY.observe("serve/total_s", done - req.admitted_at)
+            self._complete(req, ServeResult(
+                values=sub,
+                version=entry.version,
+                trace_id=req.trace_id,
+                queue_wait_s=queue_wait_s,
+                dispatch_s=dispatch_s,
+                languages=getattr(entry, "languages", None),
+            ))
+        log_event(
+            _log, "serve.dispatch", rows=rows, requests=len(live),
+            version=entry.version, dispatch_s=round(dispatch_s, 6),
+            trace_ids=[r.trace_id for r in live],
+        )
+
+    # ------------------------------------------------------------ admin -----
+    def stats(self) -> dict:
+        """Queue/backpressure snapshot for /healthz."""
+        with self._lock:
+            return {
+                "queue_depth": sum(len(q) for q in self._lanes.values()),
+                "queued_rows": self._queued_rows,
+                "inflight_rows": self._inflight_rows,
+                "ema_rows_per_s": round(self._ema_rows_per_s, 3),
+                "max_rows": self.max_rows,
+                "max_wait_ms": self.max_wait_s * 1e3,
+                "max_queue_rows": self.max_queue_rows,
+                "slo_ms": self.slo_s * 1e3,
+                "closed": self._closed,
+            }
+
+    def close(self, drain: bool = True) -> None:
+        """Stop admitting; by default drain queued requests first so no
+        admitted request is ever dropped. With ``drain=False`` queued
+        requests fail with :class:`ServeClosed` (still never a hang)."""
+        with self._cv:
+            self._closed = True
+            if not drain:
+                for lane in self._lanes.values():
+                    while lane:
+                        req = lane.popleft()
+                        self._queued_rows -= len(req.docs)
+                        self._complete(req, error=ServeClosed(
+                            f"batcher {self.name!r} closed"
+                        ))
+                self._set_queue_gauges_locked()
+            self._cv.notify_all()
+        self._thread.join(timeout=30.0)
+        log_event(_log, "serve.batcher.close", drained=drain)
+
+    def __enter__(self) -> "ContinuousBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,122 @@
+"""Stdlib HTTP client for the serving front end.
+
+Thin, dependency-free wrapper over :mod:`http.client` mirroring the
+server's endpoints — the piece that makes the smoke bench and the tests
+drive the whole stack over a real socket. One connection per call keeps
+the client trivially thread-safe (concurrent smoke clients share one
+``ServeClient``); the server is HTTP/1.1 keep-alive, so per-call
+connections cost one local TCP handshake, which is noise next to a
+scoring dispatch.
+
+Non-2xx responses raise :class:`ServeHTTPError` carrying the status and
+decoded body — a shed (503) or blown deadline (504) is an exception with
+context, never a silent empty result.
+"""
+
+from __future__ import annotations
+
+import json
+from http.client import HTTPConnection
+from typing import Sequence
+
+import numpy as np
+
+
+class ServeHTTPError(RuntimeError):
+    """Non-2xx response from the serving front end."""
+
+    def __init__(self, status: int, payload: dict, headers: dict):
+        super().__init__(
+            f"HTTP {status}: {payload.get('error', payload)!r}"
+        )
+        self.status = status
+        self.payload = payload
+        self.headers = headers
+
+    @property
+    def shed(self) -> bool:
+        return bool(self.payload.get("shed"))
+
+    @property
+    def retry_after_s(self) -> float:
+        try:
+            return float(self.headers.get("Retry-After", 0.0))
+        except ValueError:
+            return 0.0
+
+
+class ServeClient:
+    """JSON client for one serving endpoint (host, port)."""
+
+    def __init__(self, host: str, port: int, *, timeout_s: float = 60.0):
+        self.host = host
+        self.port = port
+        self.timeout_s = timeout_s
+
+    # ------------------------------------------------------------- wire -----
+    def _request(self, method: str, path: str, payload: dict | None = None):
+        conn = HTTPConnection(self.host, self.port, timeout=self.timeout_s)
+        try:
+            body = None if payload is None else json.dumps(payload)
+            headers = {"Content-Type": "application/json"} if body else {}
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            raw = resp.read()
+            data = json.loads(raw.decode("utf-8")) if raw else {}
+            if not 200 <= resp.status < 300:
+                raise ServeHTTPError(resp.status, data, dict(resp.getheaders()))
+            return data
+        finally:
+            conn.close()
+
+    # -------------------------------------------------------------- api -----
+    def score(
+        self,
+        texts: Sequence[str],
+        *,
+        priority: str = "interactive",
+        deadline_ms: float | None = None,
+        trace_id: str | None = None,
+    ) -> tuple[np.ndarray, dict]:
+        """(float32 [N, L] scores, response metadata). The JSON wire is
+        bit-transparent for float32 (exact f64 embed + round-tripping
+        doubles), so these scores equal the server-side arrays exactly."""
+        payload: dict = {"texts": list(texts), "priority": priority}
+        if deadline_ms is not None:
+            payload["deadline_ms"] = deadline_ms
+        if trace_id is not None:
+            payload["trace_id"] = trace_id
+        data = self._request("POST", "/score", payload)
+        scores = np.asarray(data.pop("scores"), dtype=np.float32)
+        if scores.size == 0:
+            scores = scores.reshape(0, 0)
+        return scores, data
+
+    def detect(
+        self,
+        texts: Sequence[str],
+        *,
+        priority: str = "interactive",
+        deadline_ms: float | None = None,
+    ) -> tuple[list[str], dict]:
+        """(predicted language labels, response metadata)."""
+        payload: dict = {"texts": list(texts), "priority": priority}
+        if deadline_ms is not None:
+            payload["deadline_ms"] = deadline_ms
+        data = self._request("POST", "/detect", payload)
+        return data.pop("labels"), data
+
+    def healthz(self) -> dict:
+        return self._request("GET", "/healthz")
+
+    def varz(self) -> dict:
+        return self._request("GET", "/varz")
+
+    def swap(self, path: str, *, version: str | None = None) -> str:
+        payload: dict = {"path": path}
+        if version is not None:
+            payload["version"] = version
+        return self._request("POST", "/admin/swap", payload)["version"]
+
+    def rollback(self) -> str:
+        return self._request("POST", "/admin/rollback")["version"]

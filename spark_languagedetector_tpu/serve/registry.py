@@ -1,0 +1,228 @@
+"""Versioned model registry: zero-downtime hot-swap for the serving path.
+
+The offline flow rebuilds a runner whenever a model param changes; a
+serving process cannot tear itself down to pick up a refitted profile.
+GSPMD's compiled-program portability (PAPERS.md: Xu et al.,
+arXiv:2105.04663) means a standby runner compiled off to the side is
+exactly as fast as the live one the moment it is flipped in — so a swap
+is: load the new :class:`~..models.profile.GramProfile` (via
+``persist.load_model`` when given a path), build its runner on the
+standby side, pre-warm the compile cache with probe docs, then atomically
+flip the serving pointer. In-flight dispatches finish on the version they
+leased (:meth:`ModelRegistry.lease` refcounts per entry); the old runner
+is drained and retired, and stays cached for instant :meth:`rollback`.
+
+Every request is answered by exactly one version: the dispatcher leases
+the active entry per dispatch, the flip happens between leases, and a
+lease pins its entry until released — no request ever observes half a
+swap (pinned by ``tests/test_serve.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Iterator, Sequence
+
+from ..telemetry import REGISTRY
+from ..utils.logging import get_logger, log_event
+from .batcher import ServeError
+
+_log = get_logger("serve.registry")
+
+# Pre-warm probe: one short and one bucket-spanning doc so the common
+# compile shapes exist before the first real request hits the new runner.
+DEFAULT_PREWARM_DOCS = (b"serve warmup", b"x" * 300)
+
+
+class ModelVersion:
+    """One registered model: its runner, language names, and lease count."""
+
+    __slots__ = (
+        "version", "model", "runner", "languages", "source",
+        "installed_at", "inflight", "retired",
+    )
+
+    def __init__(self, version, model, runner, source):
+        self.version = version
+        self.model = model
+        self.runner = runner
+        self.languages = tuple(model.profile.languages)
+        self.source = source
+        self.installed_at = time.time()
+        self.inflight = 0
+        self.retired = False
+
+    def describe(self) -> dict:
+        return {
+            "version": self.version,
+            "uid": self.model.uid,
+            "languages": len(self.languages),
+            "grams": int(self.model.profile.num_grams),
+            "source": self.source,
+            "installed_at": self.installed_at,
+            "inflight": self.inflight,
+            "retired": self.retired,
+        }
+
+
+class ModelRegistry:
+    """Serving pointer + version history with atomic flips.
+
+    ``install`` is the swap primitive (``load`` is install-from-disk):
+    the standby runner is built and pre-warmed *before* the flip, so the
+    pointer move is the only serving-visible step and takes a lock
+    acquisition, not a compile.
+    """
+
+    def __init__(
+        self,
+        *,
+        prewarm_docs: Sequence[bytes] = DEFAULT_PREWARM_DOCS,
+        drain_timeout_s: float = 10.0,
+    ):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._history: list[ModelVersion] = []
+        self._active_idx: int | None = None
+        self._counter = 0
+        self._prewarm_docs = list(prewarm_docs)
+        self._drain_timeout_s = drain_timeout_s
+
+    # ------------------------------------------------------------ swaps -----
+    def install(
+        self,
+        model,
+        *,
+        version: str | None = None,
+        prewarm: bool = True,
+        source: str | None = None,
+    ) -> str:
+        """Register ``model`` and atomically make it the serving version.
+
+        Returns the version name (auto ``v1``, ``v2``, … when not given).
+        The runner is built and optionally pre-warmed on the standby side
+        first; only then does the serving pointer flip. The previously
+        active version is drained (bounded by ``drain_timeout_s``) and
+        retired — but kept in history for :meth:`rollback`.
+        """
+        runner = model._get_runner()
+        if prewarm and self._prewarm_docs:
+            runner.score(list(self._prewarm_docs))
+        with self._cv:
+            if version is None:
+                # Auto names skip anything already registered (an explicit
+                # install may have claimed a future "vN"), so an unrelated
+                # swap can never collide with a hand-picked name.
+                self._counter += 1
+                while any(
+                    e.version == f"v{self._counter}" for e in self._history
+                ):
+                    self._counter += 1
+                version = f"v{self._counter}"
+            if any(e.version == version for e in self._history):
+                raise ServeError(f"version {version!r} already registered")
+            entry = ModelVersion(version, model, runner, source)
+            old = (
+                None if self._active_idx is None
+                else self._history[self._active_idx]
+            )
+            self._history.append(entry)
+            self._active_idx = len(self._history) - 1
+            idx = self._active_idx
+        REGISTRY.incr("serve/swaps")
+        REGISTRY.set_gauge(
+            "langdetect_serve_model_version", float(idx), version=version
+        )
+        log_event(
+            _log, "serve.swap", version=version, source=source,
+            previous=old.version if old is not None else None,
+        )
+        if old is not None:
+            self._retire(old)
+        return version
+
+    def load(self, path: str, **kw) -> str:
+        """Load a persisted model directory (``persist.load_model`` layout)
+        into a standby runner and swap it in."""
+        from ..models.estimator import LanguageDetectorModel
+
+        model = LanguageDetectorModel.load(path)
+        return self.install(model, source=str(path), **kw)
+
+    def rollback(self) -> str:
+        """Flip the serving pointer back to the previously installed
+        version (instant — its runner is still cached). The rolled-back
+        version stays in history, so repeated rollbacks walk backwards."""
+        with self._cv:
+            if self._active_idx is None or self._active_idx == 0:
+                raise ServeError("no previous version to roll back to")
+            old = self._history[self._active_idx]
+            self._active_idx -= 1
+            entry = self._history[self._active_idx]
+            entry.retired = False
+            idx = self._active_idx
+        REGISTRY.incr("serve/rollbacks")
+        REGISTRY.set_gauge(
+            "langdetect_serve_model_version", float(idx),
+            version=entry.version,
+        )
+        log_event(
+            _log, "serve.rollback", version=entry.version, from_=old.version
+        )
+        self._retire(old)
+        return entry.version
+
+    def _retire(self, entry: ModelVersion) -> None:
+        """Drain ``entry`` (wait for in-flight leases, bounded) and mark
+        it retired. A drain timeout is logged, never raised — the old
+        version finishes its dispatch and is released then."""
+        deadline = time.monotonic() + self._drain_timeout_s
+        with self._cv:
+            while entry.inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cv.wait(min(remaining, 0.1))
+            drained = entry.inflight == 0
+            entry.retired = True
+        log_event(
+            _log, "serve.retired", version=entry.version, drained=drained
+        )
+
+    # ----------------------------------------------------------- access -----
+    def peek(self) -> ModelVersion:
+        """The active entry without pinning it (shed checks, healthz)."""
+        with self._lock:
+            if self._active_idx is None:
+                raise ServeError("no model installed in the serving registry")
+            return self._history[self._active_idx]
+
+    @contextmanager
+    def lease(self) -> Iterator[ModelVersion]:
+        """Pin the active version for one dispatch. The swap flips the
+        pointer between leases; a held lease keeps its entry alive until
+        released, which is what makes every request single-version."""
+        with self._cv:
+            if self._active_idx is None:
+                raise ServeError("no model installed in the serving registry")
+            entry = self._history[self._active_idx]
+            entry.inflight += 1
+        try:
+            yield entry
+        finally:
+            with self._cv:
+                entry.inflight -= 1
+                self._cv.notify_all()
+
+    def current_version(self) -> str:
+        return self.peek().version
+
+    def versions(self) -> list[dict]:
+        with self._lock:
+            active = self._active_idx
+            return [
+                {**e.describe(), "active": i == active}
+                for i, e in enumerate(self._history)
+            ]

@@ -1,0 +1,323 @@
+"""Stdlib-only HTTP front end for the serving subsystem.
+
+A threaded ``http.server`` speaking JSON, so the whole online stack —
+admission, coalescing, shedding, hot-swap — is drivable with nothing but
+the standard library (the image bakes in no web framework, and none is
+needed: the batcher already serializes device work onto one thread, so
+the HTTP layer only has to block cheaply).
+
+Endpoints (docs/SERVING.md §2):
+
+  * ``POST /score``   ``{"texts": [...], "priority"?, "deadline_ms"?}``
+    → ``{"scores": [[...]], "version", "trace_id", ...}``
+  * ``POST /detect``  same request shape → ``{"labels": [...], ...}``
+  * ``GET  /healthz`` liveness + queue/breaker/version snapshot
+  * ``GET  /varz``    telemetry: stage summaries, counters, gauges, and
+    the serve latency histograms
+  * ``POST /admin/swap``     ``{"path": "<model dir>"}`` → hot-swap
+  * ``POST /admin/rollback`` → previous version
+
+Failure mapping: a shed request answers ``503`` with a ``Retry-After``
+header, a blown deadline ``504``, a bad request ``400`` — never a hang
+(the acceptance contract: shed means an explicit rejection).
+
+Texts are encoded server-side with the active model's
+``predictEncoding`` param, so HTTP clients get byte-identical semantics
+to calling ``model.transform`` locally.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..ops.encoding import UTF8, text_to_bytes
+from ..telemetry import REGISTRY
+from ..utils.logging import get_logger, log_event
+from .batcher import (
+    LANES,
+    ContinuousBatcher,
+    ServeClosed,
+    ServeDeadlineExceeded,
+    ServeError,
+    ServeOverloaded,
+)
+from .registry import ModelRegistry
+
+_log = get_logger("serve.server")
+
+MAX_BODY_BYTES = 64 << 20  # one request can still carry a bulk doc list
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "langdetect-serve"
+
+    # ------------------------------------------------------------ plumbing --
+    def log_message(self, fmt, *args):  # route access logs to our logger
+        _log.debug("%s %s", self.address_string(), fmt % args)
+
+    def _reply(self, status: int, payload: dict, headers: dict | None = None):
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_json(self) -> dict:
+        length = int(self.headers.get("Content-Length") or 0)
+        if length > MAX_BODY_BYTES:
+            raise ValueError(f"request body too large ({length} bytes)")
+        raw = self.rfile.read(length) if length else b""
+        payload = json.loads(raw.decode("utf-8")) if raw else {}
+        if not isinstance(payload, dict):
+            raise ValueError("request body must be a JSON object")
+        return payload
+
+    # ------------------------------------------------------------- routes --
+    def do_GET(self):
+        try:
+            if self.path == "/healthz":
+                self._reply(200, self.server.healthz())
+            elif self.path == "/varz":
+                self._reply(200, self.server.varz())
+            else:
+                self._reply(404, {"error": f"unknown path {self.path!r}"})
+        except Exception as e:  # never let a probe kill the connection
+            self._reply(500, {"error": repr(e)})
+
+    def do_POST(self):
+        try:
+            payload = self._read_json()
+        except json.JSONDecodeError as e:  # before ValueError: its subclass
+            self._reply(400, {"error": f"bad JSON: {e}"})
+            return
+        except ValueError as e:
+            self._reply(400, {"error": str(e)})
+            return
+        try:
+            if self.path == "/score":
+                self._reply(200, self.server.score(payload, labels=False))
+            elif self.path == "/detect":
+                self._reply(200, self.server.score(payload, labels=True))
+            elif self.path == "/admin/swap":
+                self._reply(200, self.server.swap(payload))
+            elif self.path == "/admin/rollback":
+                self._reply(200, self.server.rollback())
+            else:
+                self._reply(404, {"error": f"unknown path {self.path!r}"})
+        except ServeOverloaded as e:
+            self._reply(
+                503,
+                {"error": str(e), "shed": True, "reason": e.reason},
+                {"Retry-After": f"{max(e.retry_after_s, 0.001):.3f}"},
+            )
+        except ServeDeadlineExceeded as e:
+            self._reply(504, {"error": str(e), "deadline": True})
+        except ServeClosed as e:
+            self._reply(503, {"error": str(e), "closed": True})
+        except (ValueError, KeyError) as e:
+            self._reply(400, {"error": repr(e)})
+        except Exception as e:
+            self._reply(500, {"error": repr(e)})
+
+
+class ServingServer(ThreadingHTTPServer):
+    """HTTP front end bound to a registry + batcher.
+
+    ``registry`` may be a :class:`~.registry.ModelRegistry` or a fitted
+    ``LanguageDetectorModel`` (wrapped into a fresh registry). The
+    batcher defaults to env-tuned knobs; pass one to share it with
+    in-process callers. ``port=0`` binds an ephemeral port (tests).
+    """
+
+    daemon_threads = True
+
+    def __init__(
+        self,
+        registry,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 8000,
+        batcher: ContinuousBatcher | None = None,
+        admin: bool = True,
+        **batcher_kw,
+    ):
+        if not hasattr(registry, "lease"):
+            model, registry = registry, ModelRegistry()
+            registry.install(model)
+        self.registry = registry
+        self._own_batcher = batcher is None
+        self.batcher = batcher or ContinuousBatcher(registry, **batcher_kw)
+        self.admin = admin
+        self._started = time.monotonic()
+        self._thread: threading.Thread | None = None
+        super().__init__((host, port), _Handler)
+
+    # --------------------------------------------------------- lifecycle ----
+    @property
+    def address(self) -> tuple[str, int]:
+        return self.server_address[0], self.server_address[1]
+
+    def start(self) -> "ServingServer":
+        """Serve on a daemon thread; returns self (``with`` works too)."""
+        self._thread = threading.Thread(
+            target=self.serve_forever, name="serve-http", daemon=True
+        )
+        self._thread.start()
+        log_event(_log, "serve.http.start", host=self.address[0],
+                  port=self.address[1])
+        return self
+
+    def stop(self) -> None:
+        self.shutdown()
+        self.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+        if self._own_batcher:
+            self.batcher.close()
+        log_event(_log, "serve.http.stop", port=self.address[1])
+
+    def __enter__(self) -> "ServingServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ---------------------------------------------------------- handlers ----
+    def score(self, payload: dict, *, labels: bool) -> dict:
+        texts = payload.get("texts", payload.get("docs"))
+        if not isinstance(texts, list) or not all(
+            isinstance(t, str) for t in texts
+        ):
+            raise ValueError('"texts" must be a list of strings')
+        priority = payload.get("priority", "interactive")
+        if priority not in LANES:
+            raise ValueError(
+                f"unknown priority {priority!r}; expected one of {LANES}"
+            )
+        deadline_ms = payload.get("deadline_ms")
+        if deadline_ms is not None:
+            deadline_ms = float(deadline_ms)
+        # Encoding is resolved at ADMISSION against the active version; a
+        # concurrent swap that also changes predictEncoding could dispatch
+        # these bytes on the new version. Keep predictEncoding consistent
+        # across versions you hot-swap between (or drain first) — swapping
+        # the encoding mid-traffic has no well-defined answer for requests
+        # already in the queue (docs/SERVING.md §2).
+        entry = self.registry.peek()
+        encoding = (
+            entry.model.get("predictEncoding")
+            if entry.model is not None else UTF8
+        )
+        docs = [text_to_bytes(t, encoding) for t in texts]
+        fut = self.batcher.submit(
+            docs, priority=priority, want_labels=labels,
+            deadline_ms=deadline_ms, trace_id=payload.get("trace_id"),
+        )
+        result = fut.result()
+        out = {
+            "version": result.version,
+            "trace_id": result.trace_id,
+            "queue_wait_ms": round(result.queue_wait_s * 1e3, 3),
+            "dispatch_ms": round(result.dispatch_s * 1e3, 3),
+        }
+        if labels:
+            out["labels"] = result.labels
+        else:
+            # float() of a float32 is exact (f32 ⊂ f64) and JSON doubles
+            # round-trip, so the wire is bit-transparent for scores.
+            out["scores"] = [
+                [float(v) for v in row] for row in result.values
+            ]
+        return out
+
+    def swap(self, payload: dict) -> dict:
+        if not self.admin:
+            raise ServeError("admin endpoints disabled")
+        path = payload.get("path")
+        if not isinstance(path, str) or not path:
+            raise ValueError('"path" must name a saved model directory')
+        version = self.registry.load(path, version=payload.get("version"))
+        return {"version": version}
+
+    def rollback(self) -> dict:
+        if not self.admin:
+            raise ServeError("admin endpoints disabled")
+        return {"version": self.registry.rollback()}
+
+    def healthz(self) -> dict:
+        out = {
+            "ok": True,
+            "uptime_s": round(time.monotonic() - self._started, 3),
+            "batcher": self.batcher.stats(),
+        }
+        try:
+            entry = self.registry.peek()
+            runner = entry.runner
+            out["version"] = entry.version
+            out["languages"] = len(entry.languages or ())
+            breaker = getattr(runner, "breaker", None)
+            out["breaker"] = breaker.state if breaker is not None else None
+            out["degraded"] = bool(getattr(runner, "_degraded_mode", False))
+        except ServeError as e:
+            out["ok"] = False
+            out["error"] = str(e)
+        return out
+
+    def varz(self) -> dict:
+        snap = REGISTRY.snapshot()
+        return {
+            "stages": REGISTRY.stage_summary(),
+            "counters": snap["counters"],
+            "gauges": snap["gauges"],
+            "histograms": {
+                name: h for name, h in snap["histograms"].items()
+                if not name.startswith(("span:", "span_device:"))
+            },
+            "versions": (
+                self.registry.versions()
+                if hasattr(self.registry, "versions") else []
+            ),
+        }
+
+
+def main(argv: list[str] | None = None) -> int:
+    """``python -m spark_languagedetector_tpu.serve.server <model_dir>
+    [host:port]`` — load a persisted model and serve it."""
+    import sys
+
+    argv = sys.argv[1:] if argv is None else argv
+    if not 1 <= len(argv) <= 2 or argv[0] in ("-h", "--help"):
+        print(
+            "usage: python -m spark_languagedetector_tpu.serve.server "
+            "<model_dir> [host:port]",
+            file=sys.stderr,
+        )
+        return 2
+    host, port = "127.0.0.1", 8000
+    if len(argv) == 2:
+        host, _, p = argv[1].rpartition(":")
+        host = host or "127.0.0.1"
+        port = int(p)
+    registry = ModelRegistry()
+    registry.load(argv[0])
+    server = ServingServer(registry, host=host, port=port)
+    print(f"serving {registry.current_version()} on "
+          f"{server.address[0]}:{server.address[1]}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -8,8 +8,11 @@ Turns the bench trajectory into an enforceable contract: capture A is the
 accepted baseline (a BENCH_r* run's JSONL, a CI artifact), capture B is
 the candidate; for every span path present in both, the wall-time
 percentiles (and fenced device totals, the snapshot-carried
-fill/waste/stall histograms, and the snapshot's recovery counters —
-retries, breaker trips, DLQ rows, degraded batches) are compared, and
+fill/waste/stall histograms — the serving latency legs
+``serve/queue_wait_s`` / ``serve/dispatch_s`` / ``serve/total_s``
+included, so a serve p99 regression past threshold fails the run — and
+the snapshot's recovery counters: retries, breaker trips, DLQ rows,
+degraded batches, shed requests) are compared, and
 any metric that moved past
 ``--threshold`` (relative, in the *worse* direction — slower, less
 filled, more wasted) fails the run with exit code 1. Stages present in
@@ -81,17 +84,25 @@ def capture_stats(events: list[dict]) -> dict:
                 if isinstance(v, dict) and v.get("count")
             }
         # Recovery-behavior counters (retries, breaker trips, DLQ rows,
-        # degraded batches): a regression here is a reliability story even
-        # when every latency percentile held steady, so the guard diffs
-        # them like any other metric (docs/RESILIENCE.md §7).
+        # degraded batches, serve sheds/deadline rejections): a regression
+        # here is a reliability story even when every latency percentile
+        # held steady, so the guard diffs them like any other metric
+        # (docs/RESILIENCE.md §7, docs/SERVING.md §6). Only the serving
+        # counters that measure *rejection* regress — throughput counters
+        # like serve/coalesced_rows legitimately grow with load.
         cpayload = ev.get("counters")
         if isinstance(cpayload, dict):
             counters = {
                 str(k): v for k, v in cpayload.items()
                 if isinstance(v, (int, float))
                 and (
-                    str(k).startswith("resilience/")
-                    or str(k) in ("score/retries", "stream/retries")
+                    str(k).startswith(("resilience/", "serve/shed"))
+                    or str(k) in (
+                        "score/retries",
+                        "stream/retries",
+                        "serve/deadline_rejects",
+                        "serve/dispatch_errors",
+                    )
                 )
             }
     return {"stages": stages, "histograms": hists, "counters": counters}

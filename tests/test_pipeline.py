@@ -188,3 +188,91 @@ def test_transformer_before_estimator_only_applies_to_prefix():
     model = Pipeline([lower, det]).fit(Table(ROWS))
     grams = set(model.stages[-1].gram_probabilities)
     assert not any(any(0x41 <= b <= 0x5A for b in g) for g in grams)
+
+
+def test_save_replace_failure_preserves_old_and_new(tmp_path, monkeypatch):
+    """A failed tmp→root rename must destroy NEITHER save: the old tree is
+    renamed aside before the swap and restored on failure, and the freshly
+    built tmp tree stays on disk (it is the only copy of the new data)."""
+    import os as _os
+
+    path = str(tmp_path / "pipe")
+    first = _pipeline().fit(Table(ROWS))
+    first.save(path)
+    first_uid = first.uid
+
+    second = _pipeline().fit(Table(ROWS))
+    real_replace = _os.replace
+
+    def failing_replace(src, dst):
+        # Only the final tmp→root swap fails; the old-root-aside rename
+        # (root → .old.) and any stage-level renames still work.
+        if ".tmp." in str(src) and str(dst) == path:
+            raise OSError("injected replace failure")
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(_os, "replace", failing_replace)
+    with pytest.raises(OSError, match="injected replace failure"):
+        second.save(path)
+    monkeypatch.setattr(_os, "replace", real_replace)
+
+    # Old save restored and loadable.
+    restored = PipelineModel.load(path)
+    assert restored.uid == first_uid
+    # New save's tmp tree survived for recovery.
+    tmp_dirs = [
+        p for p in (tmp_path).iterdir() if ".tmp." in p.name
+    ]
+    assert tmp_dirs, "tmp tree was deleted along with the failed swap"
+    loaded_new = PipelineModel.load(str(tmp_dirs[0]))
+    assert loaded_new.uid == second.uid
+
+
+def test_save_midbuild_failure_keeps_old_and_cleans_tmp(tmp_path):
+    """A failure while building the temp tree (before any swap) leaves the
+    existing save untouched and removes the partial tmp tree."""
+    path = str(tmp_path / "pipe")
+    first = _pipeline().fit(Table(ROWS))
+    first.save(path)
+
+    class ExplodingStage:
+        uid = "Exploding_stage"
+
+        def transform(self, dataset):
+            return dataset
+
+        # has neither write() nor param_metadata → TypeError mid-build
+
+    with pytest.raises(TypeError, match="cannot persist"):
+        PipelineModel([ExplodingStage()]).save(path)
+    assert PipelineModel.load(path).uid == first.uid
+    assert not [p for p in tmp_path.iterdir() if ".tmp." in p.name]
+
+
+@pytest.mark.parametrize(
+    "bad", ["", "..", ".", "a/b", "a\\b", "a b", "..\\up", "é"]
+)
+def test_stage_dir_name_validation_rejects(tmp_path, bad):
+    """Stage dir names from metadata are allowlisted to [A-Za-z0-9._-]+
+    minus '.'/'..' — empty strings and backslashes are rejected too."""
+    model = _pipeline().fit(Table(ROWS))
+    path = str(tmp_path / "pipe")
+    model.save(path)
+    import json as _json
+    from pathlib import Path as _Path
+
+    meta_file = _Path(path) / "metadata" / "part-00000"
+    meta = _json.loads(meta_file.read_text())
+    meta["stages"][0]["dir"] = bad
+    meta_file.write_text(_json.dumps(meta) + "\n")
+    with pytest.raises(ValueError, match="refusing stage directory"):
+        PipelineModel.load(path)
+
+
+def test_stage_dir_name_validation_accepts_normal_names(tmp_path):
+    """Round-trip still works: real stage dir names (NN_Prefix_hex) pass."""
+    model = _pipeline().fit(Table(ROWS))
+    path = str(tmp_path / "pipe")
+    model.save(path)
+    loaded = PipelineModel.load(path)
+    assert [s.uid for s in loaded.stages] == [s.uid for s in model.stages]

@@ -2,6 +2,7 @@
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from spark_languagedetector_tpu.api.runner import BatchRunner
 from spark_languagedetector_tpu.models.profile import GramProfile
@@ -210,3 +211,132 @@ def test_model_max_score_bytes_param():
         model.save(d + "/m")
         loaded = LanguageDetectorModel.load(d + "/m")
         assert loaded.get("maxScoreBytes") == 8
+
+
+def test_max_score_bytes_low_byte_encoding_hard_slice():
+    """With a non-UTF-8 encoding the cap is a hard byte slice: low_byte
+    docs full of 0x80-0xBF bytes (ordinary characters there) must not be
+    mistaken for UTF-8 continuations — the old behavior backed the cap
+    off arbitrarily far below maxScoreBytes (ADVICE r5)."""
+    from spark_languagedetector_tpu.ops.encoding import (
+        LOW_BYTE,
+        truncate_utf8,
+    )
+
+    profile = GramProfile.from_gram_map(GRAM_MAP, LANGS, (2, 3))
+    weights, lut = profile.device_arrays()
+
+    def runner(cap=None, encoding=LOW_BYTE):
+        return BatchRunner(
+            weights=weights, lut=lut, spec=profile.spec, batch_size=4,
+            length_buckets=(16, 64), max_score_bytes=cap,
+            score_encoding=encoding,
+        )
+
+    # A doc whose bytes past index 3 are all in 0x80-0xBF: utf-8
+    # backtracking walks from the cap down to the first non-continuation
+    # byte and keeps 3 bytes of 15; the hard slice keeps all 15.
+    pathological = b"abc" + b"\xa0" * 40
+    assert len(truncate_utf8(pathological, 15)) == 2  # the old misread
+    docs = [pathological, b"ab" * 20, b"zz", b""]
+    capped = runner(cap=15).score(docs)
+    manual = runner().score([d[:15] for d in docs])
+    np.testing.assert_array_equal(capped, manual)
+
+    # UTF-8 runners keep the boundary-safe behavior.
+    utf8_capped = runner(cap=15, encoding="utf8").score(docs)
+    utf8_manual = runner(encoding="utf8").score(
+        [truncate_utf8(d, 15) for d in docs]
+    )
+    np.testing.assert_array_equal(utf8_capped, utf8_manual)
+
+    with pytest.raises(ValueError, match="score_encoding"):
+        runner(encoding="latin1")
+
+
+def test_model_low_byte_encoding_plumbs_to_runner():
+    """predictEncoding reaches the runner: a low_byte model with a cap
+    scores like hard-sliced low_byte docs (not utf-8 backtracked ones)."""
+    from spark_languagedetector_tpu import LanguageDetectorModel, Table
+    from spark_languagedetector_tpu.ops.encoding import (
+        LOW_BYTE,
+        text_to_bytes,
+    )
+
+    model = LanguageDetectorModel.from_gram_map(GRAM_MAP, (2, 3), LANGS)
+    model.set_predict_encoding(LOW_BYTE)
+    model.set_max_score_bytes(8)
+    assert model._get_runner().score_encoding == LOW_BYTE
+
+    # U+00A0 encodes to the single byte 0xA0 under low_byte.
+    texts = ["ab       ab", "abzz"]
+    got = list(model.transform(Table({"fulltext": texts})).column("lang"))
+    ref = LanguageDetectorModel.from_gram_map(GRAM_MAP, (2, 3), LANGS)
+    ref_runner = ref._get_runner()
+    want_ids = ref_runner.predict_ids(
+        [text_to_bytes(t, LOW_BYTE)[:8] for t in texts]
+    )
+    assert got == [LANGS[i] for i in want_ids]
+
+
+def test_concurrent_score_callers_bitwise_identical():
+    """The batcher's contract: N threads calling score()/predict_ids()
+    concurrently on ONE runner get results bit-identical to serial calls
+    — including under a chaos plan at score/dispatch (transients replay
+    exactly)."""
+    import threading
+
+    from spark_languagedetector_tpu.resilience import faults
+    from spark_languagedetector_tpu.resilience.faults import FaultPlan
+
+    rng = np.random.default_rng(53)
+    spec = VocabSpec(EXACT, (1, 2))
+    weights = rng.normal(size=(spec.id_space_size, 4)).astype(np.float32)
+    runner = BatchRunner(
+        weights=jnp.asarray(weights), lut=None, spec=spec,
+        strategy="gather", length_buckets=(32, 64), batch_size=4,
+    )
+    doc_sets = [
+        [
+            bytes(rng.integers(97, 122, rng.integers(0, 90)).tolist())
+            for _ in range(7)
+        ] + [b"", bytes(b"xy" * 100)]  # empty + chunked (> 64)
+        for _ in range(8)
+    ]
+    serial_scores = [runner.score(ds) for ds in doc_sets]
+    serial_ids = [runner.predict_ids(ds) for ds in doc_sets]
+
+    def run_threads():
+        out_scores = [None] * len(doc_sets)
+        out_ids = [None] * len(doc_sets)
+
+        def work(i):
+            out_scores[i] = runner.score(doc_sets[i])
+            out_ids[i] = runner.predict_ids(doc_sets[i])
+
+        threads = [
+            threading.Thread(target=work, args=(i,))
+            for i in range(len(doc_sets))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return out_scores, out_ids
+
+    got_scores, got_ids = run_threads()
+    for want, got in zip(serial_scores, got_scores):
+        np.testing.assert_array_equal(want, got)
+    for want, got in zip(serial_ids, got_ids):
+        np.testing.assert_array_equal(want, got)
+
+    # Same contract with injected dispatch transients: the policy replays
+    # the failed batch verbatim, so results stay exact.
+    with faults.plan_scope(
+        FaultPlan.parse("seed=11;score/dispatch:error@2,7,13")
+    ):
+        chaos_scores, chaos_ids = run_threads()
+    for want, got in zip(serial_scores, chaos_scores):
+        np.testing.assert_array_equal(want, got)
+    for want, got in zip(serial_ids, chaos_ids):
+        np.testing.assert_array_equal(want, got)

@@ -1,0 +1,623 @@
+"""Online serving subsystem: coalescing parity, shedding, hot-swap, HTTP.
+
+The acceptance contract (ISSUE 5): serving-path scores are bit-identical
+to direct ``BatchRunner.score`` for the same documents — across threads,
+across a hot-swap boundary, and end-to-end over the HTTP front end —
+every request answered exactly once by exactly one model version, and
+shed requests rejected explicitly, never hung.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_languagedetector_tpu import LanguageDetectorModel
+from spark_languagedetector_tpu.api.runner import BatchRunner
+from spark_languagedetector_tpu.models.profile import GramProfile
+from spark_languagedetector_tpu.ops.encoding import texts_to_bytes
+from spark_languagedetector_tpu.resilience import faults
+from spark_languagedetector_tpu.resilience.faults import FaultPlan
+from spark_languagedetector_tpu.resilience.policy import CircuitBreaker
+from spark_languagedetector_tpu.serve import (
+    BULK,
+    ContinuousBatcher,
+    ModelRegistry,
+    ServeClosed,
+    ServeDeadlineExceeded,
+    ServeOverloaded,
+)
+from spark_languagedetector_tpu.telemetry import REGISTRY
+
+LANGS = ("x", "y")
+GRAM_MAP = {
+    b"ab": [1.0, 0.0],
+    b"bc": [0.5, 0.5],
+    b"zz": [0.0, 2.0],
+    b"abc": [3.0, 0.0],
+}
+
+
+def _runner(**kw):
+    profile = GramProfile.from_gram_map(GRAM_MAP, LANGS, (2, 3))
+    weights, lut = profile.device_arrays()
+    kw.setdefault("batch_size", 4)
+    kw.setdefault("length_buckets", (16, 64))
+    return BatchRunner(weights=weights, lut=lut, spec=profile.spec, **kw)
+
+
+def _docs(rng, n, max_len=90):
+    return [
+        bytes(rng.integers(97, 123, rng.integers(0, max_len)).tolist())
+        for _ in range(n)
+    ]
+
+
+class SpyRunner:
+    """Delegating runner that records each coalesced dispatch's docs."""
+
+    def __init__(self, runner, sleep_s: float = 0.0):
+        self.runner = runner
+        self.sleep_s = sleep_s
+        self.calls: list[list[bytes]] = []
+
+    @property
+    def breaker(self):
+        return self.runner.breaker
+
+    def score(self, docs):
+        self.calls.append(list(docs))
+        if self.sleep_s:
+            time.sleep(self.sleep_s)
+        return self.runner.score(docs)
+
+    def predict_ids(self, docs):
+        self.calls.append(list(docs))
+        return self.runner.predict_ids(docs)
+
+
+# --------------------------------------------------------------- batcher ----
+def test_batcher_bit_identical_to_direct_score():
+    """Mixed-bucket docs (empty, short, chunked-long) through the batcher
+    equal direct runner.score exactly, for scores and labels."""
+    runner = _runner()
+    rng = np.random.default_rng(7)
+    docs = _docs(rng, 9) + [b"", b"ab" * 80]  # chunked at 160 > 64
+    with ContinuousBatcher(runner, max_wait_ms=2, max_rows=64) as b:
+        got = b.submit(docs).result(timeout=30)
+        np.testing.assert_array_equal(got.values, runner.score(docs))
+        assert got.version == "v0"
+        ids = b.submit(docs, want_labels=True).result(timeout=30)
+        np.testing.assert_array_equal(ids.values, runner.predict_ids(docs))
+
+
+def test_batcher_concurrent_callers_bit_identical_and_coalesced():
+    """N concurrent submitters: every response equals its direct score
+    bit-for-bit, and the dispatcher demonstrably coalesces (fewer
+    dispatches than requests)."""
+    runner = _runner(batch_size=64)
+    rng = np.random.default_rng(11)
+    doc_sets = [_docs(rng, 4) for _ in range(12)]
+    want = [runner.score(ds) for ds in doc_sets]
+    spy = SpyRunner(runner)
+    REGISTRY.reset()
+    with ContinuousBatcher(spy, max_wait_ms=40, max_rows=256) as b:
+        barrier = threading.Barrier(len(doc_sets))
+        got: list = [None] * len(doc_sets)
+
+        def work(i):
+            barrier.wait(timeout=10)
+            got[i] = b.submit(doc_sets[i]).result(timeout=30)
+
+        threads = [
+            threading.Thread(target=work, args=(i,))
+            for i in range(len(doc_sets))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    for i in range(len(doc_sets)):
+        np.testing.assert_array_equal(got[i].values, want[i])
+    assert len(spy.calls) < len(doc_sets)  # coalesced
+    snap = REGISTRY.snapshot()
+    h = snap["histograms"]["serve/rows_per_dispatch"]
+    assert h["mean"] > 4  # more than one request's rows per dispatch
+    assert snap["counters"]["serve/coalesced_rows"] == 4 * len(doc_sets)
+    # The three latency legs are present for the telemetry capture.
+    for name in ("serve/queue_wait_s", "serve/dispatch_s", "serve/total_s"):
+        assert snap["histograms"][name]["count"] > 0
+
+
+def test_batcher_priority_lane_order():
+    """Interactive requests ride ahead of earlier-admitted bulk requests
+    in the coalesced dispatch."""
+    runner = _runner()
+    spy = SpyRunner(runner)
+    bulk_docs = [b"zzzz", b"zz"]
+    inter_docs = [b"abab"]
+    with ContinuousBatcher(spy, max_wait_ms=300, max_rows=999) as b:
+        f_bulk = b.submit(bulk_docs, priority=BULK)
+        f_inter = b.submit(inter_docs)
+        f_bulk.result(timeout=30)
+        f_inter.result(timeout=30)
+    assert spy.calls[0] == inter_docs + bulk_docs
+
+
+def test_batcher_flushes_on_max_rows_without_waiting():
+    """A full queue flushes immediately — well before max_wait."""
+    runner = _runner()
+    with ContinuousBatcher(runner, max_wait_ms=10_000, max_rows=4) as b:
+        t0 = time.monotonic()
+        out = b.submit([b"ab", b"bc", b"zz", b"abc"]).result(timeout=30)
+        assert time.monotonic() - t0 < 5.0
+        assert out.values.shape == (4, 2)
+
+
+def test_batcher_deadline_rejected_explicitly():
+    """A request whose deadline passes while queued gets
+    ServeDeadlineExceeded — not a hang, not a stale response."""
+    runner = _runner()
+    spy = SpyRunner(runner, sleep_s=0.3)
+    with ContinuousBatcher(spy, max_wait_ms=1, max_rows=8) as b:
+        blocker = b.submit([b"ab"] * 4)  # occupies the dispatcher 0.3s
+        for _ in range(200):  # wait until the dispatcher is actually busy
+            if spy.calls:
+                break
+            time.sleep(0.005)
+        doomed = b.submit([b"zz"], deadline_ms=1.0)
+        blocker.result(timeout=30)
+        with pytest.raises(ServeDeadlineExceeded):
+            doomed.result(timeout=30)
+
+
+def test_batcher_shed_queue_full():
+    """Reject-newest: admissions past the queue bound shed with an
+    explicit ServeOverloaded; queued work is answered."""
+    runner = _runner()
+    spy = SpyRunner(runner, sleep_s=0.2)
+    REGISTRY.reset()
+    with ContinuousBatcher(spy, max_wait_ms=1, max_rows=4,
+                           max_queue_rows=8) as b:
+        first = b.submit([b"ab"] * 4)  # heads into dispatch (sleeping)
+        for _ in range(200):  # wait until the dispatcher picked it up
+            if spy.calls:
+                break
+            time.sleep(0.005)
+        queued = b.submit([b"bc"] * 8)  # fills the queue bound
+        with pytest.raises(ServeOverloaded) as exc:
+            b.submit([b"zz"])
+        assert exc.value.reason == "queue_full"
+        assert exc.value.retry_after_s > 0
+        first.result(timeout=30)
+        queued.result(timeout=30)
+    assert REGISTRY.snapshot()["counters"]["serve/shed_queue_full"] == 1
+    assert REGISTRY.snapshot()["counters"]["serve/shed_rows"] == 1
+
+
+def test_batcher_shed_slo_estimated_wait():
+    """With a throughput estimate established, an admission whose
+    estimated wait exceeds the SLO sheds."""
+    runner = _runner()
+    with ContinuousBatcher(runner, max_wait_ms=500, max_rows=999,
+                           slo_ms=100) as b:
+        b._ema_rows_per_s = 10.0  # 10 rows/s measured
+        b.submit([b"ab"] * 8)  # 8 rows queued => est wait 0.8s > 0.1s
+        with pytest.raises(ServeOverloaded) as exc:
+            b.submit([b"zz"])
+        assert exc.value.reason == "slo"
+
+
+def test_batcher_breaker_open_sheds_bulk_serves_interactive():
+    """Breaker-open flows into shed decisions: bulk requests shed, while
+    interactive requests are still served exactly (degraded ladder)."""
+    runner = _runner()
+    runner.breaker = CircuitBreaker(failure_threshold=1, cooldown_s=60.0,
+                                    name="test")
+    direct = _runner()
+    docs = [b"abab", b"zz"]
+    want = direct.score(docs)
+    runner.breaker.record_failure()
+    assert runner.breaker.state == "open"
+    with ContinuousBatcher(runner, max_wait_ms=2, max_rows=8) as b:
+        with pytest.raises(ServeOverloaded) as exc:
+            b.submit(docs, priority=BULK)
+        assert exc.value.reason == "degraded"
+        got = b.submit(docs).result(timeout=30)  # interactive passes
+        np.testing.assert_array_equal(got.values, want)
+
+
+def test_chaos_serve_admit_site_forces_shed():
+    """An injected serve/admit fault is converted into the shed path —
+    deterministic rejection, next admission unaffected."""
+    runner = _runner()
+    REGISTRY.reset()
+    with ContinuousBatcher(runner, max_wait_ms=2, max_rows=8) as b:
+        with faults.plan_scope(FaultPlan.parse("seed=3;serve/admit:error@1")):
+            with pytest.raises(ServeOverloaded) as exc:
+                b.submit([b"ab"])
+            assert exc.value.reason == "injected"
+            out = b.submit([b"ab"]).result(timeout=30)
+            np.testing.assert_array_equal(out.values, runner.score([b"ab"]))
+    counters = REGISTRY.snapshot()["counters"]
+    assert counters["serve/shed_injected"] == 1
+    assert counters["resilience/faults_injected"] == 1
+
+
+def test_batcher_close_drains_then_rejects():
+    """close() answers everything already admitted, then new submissions
+    fail fast with ServeClosed."""
+    runner = _runner()
+    spy = SpyRunner(runner, sleep_s=0.1)
+    b = ContinuousBatcher(spy, max_wait_ms=50, max_rows=4)
+    futures = [b.submit([b"ab", b"bc"]) for _ in range(3)]
+    b.close()
+    for f in futures:
+        assert f.result(timeout=30).values.shape == (2, 2)
+    with pytest.raises(ServeClosed):
+        b.submit([b"zz"])
+
+
+def test_batcher_dispatch_error_propagates_to_all_requests():
+    """A dispatch that exhausts the runner's recovery fails every request
+    in the batch with the error — explicit failure, not a hang."""
+
+    class ExplodingRunner:
+        breaker = None
+
+        def score(self, docs):
+            raise ValueError("deterministic scorer bug")
+
+    with ContinuousBatcher(ExplodingRunner(), max_wait_ms=20,
+                           max_rows=8) as b:
+        f1 = b.submit([b"ab"])
+        f2 = b.submit([b"bc"])
+        with pytest.raises(ValueError, match="deterministic scorer bug"):
+            f1.result(timeout=30)
+        with pytest.raises(ValueError, match="deterministic scorer bug"):
+            f2.result(timeout=30)
+
+
+def test_batcher_survives_cancelled_future():
+    """A caller cancelling its pending future must not kill the
+    dispatcher: the cancelled request is dropped, coalesced neighbors
+    and later requests are answered normally."""
+    runner = _runner()
+    spy = SpyRunner(runner, sleep_s=0.2)
+    with ContinuousBatcher(spy, max_wait_ms=30, max_rows=16) as b:
+        blocker = b.submit([b"ab"] * 2)  # occupies the dispatcher
+        for _ in range(200):
+            if spy.calls:
+                break
+            time.sleep(0.005)
+        doomed = b.submit([b"zz"])
+        neighbor = b.submit([b"bc"])
+        assert doomed.cancel()  # still queued: cancel succeeds
+        blocker.result(timeout=30)
+        np.testing.assert_array_equal(
+            neighbor.result(timeout=30).values, runner.score([b"bc"])
+        )
+        # Dispatcher thread alive: a fresh request still completes.
+        after = b.submit([b"abc"]).result(timeout=30)
+        np.testing.assert_array_equal(after.values, runner.score([b"abc"]))
+
+
+def test_batcher_empty_request_answers_immediately():
+    """A zero-document request resolves with the runner's own empty
+    shape instead of hanging the never-woken dispatcher."""
+    runner = _runner()
+    with ContinuousBatcher(runner, max_wait_ms=5, max_rows=8) as b:
+        res = b.submit([]).result(timeout=10)
+        np.testing.assert_array_equal(res.values, runner.score([]))
+        assert res.values.shape == (0, 2)
+        ids = b.submit([], want_labels=True).result(timeout=10)
+        assert ids.values.shape == (0,)
+        # And a normal request afterwards still works.
+        out = b.submit([b"ab"]).result(timeout=30)
+        np.testing.assert_array_equal(out.values, runner.score([b"ab"]))
+
+
+def test_registry_explicit_version_never_collides_with_auto():
+    """An explicitly named 'vN' must not break later auto-named installs."""
+    registry = ModelRegistry(drain_timeout_s=0.5)
+    registry.install(_model(seed=11), version="v2")
+    assert registry.install(_model(seed=12)) == "v1"
+    assert registry.install(_model(seed=13)) == "v3"  # skips taken v2
+    assert registry.current_version() == "v3"
+
+
+def test_batcher_matmul_strategy_labels_exact_scores_close():
+    """Bit-identity is pinned on the geometry-stable gather strategy
+    (tests above). Matmul strategies (onehot on CPU, MXU kernels on TPU)
+    may flip the last f32 bit when a doc rides a different coalesce
+    geometry — XLA's gemm reduction order varies with batch shape
+    (ARCHITECTURE.md's reduction-order class). The serving contract
+    there: argmax labels exact, scores within reduction-order tolerance
+    (the batcher itself adds no numeric step either way)."""
+    from spark_languagedetector_tpu import LanguageDetector, Table
+
+    langs = ["aa", "bb"]
+    model = LanguageDetector(langs, [1, 2], 100).fit(Table({
+        "lang": ["aa", "aa", "bb", "bb"],
+        "fulltext": ["alpha aard apple", "ant arm area",
+                     "bubble bob bay", "bin bone bulk"],
+    }))
+    runner = model._get_runner()
+    assert runner.strategy == "onehot"
+    texts = ["alpha arm", "bubble bin", "area bay zz"]
+    docs = texts_to_bytes(texts)
+    direct = runner.score(docs)
+    direct_ids = runner.predict_ids(docs)
+    with ContinuousBatcher(runner, max_wait_ms=40, max_rows=256) as b:
+        barrier = threading.Barrier(6)
+        got: list = [None] * 6
+        got_ids: list = [None] * 6
+
+        def work(i):
+            barrier.wait(timeout=10)
+            got[i] = b.submit(docs).result(timeout=30)
+            got_ids[i] = b.submit(docs, want_labels=True).result(timeout=30)
+
+        threads = [
+            threading.Thread(target=work, args=(i,)) for i in range(6)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    for res, ids in zip(got, got_ids):
+        np.testing.assert_allclose(res.values, direct, rtol=1e-4, atol=1e-5)
+        np.testing.assert_array_equal(ids.values, direct_ids)
+
+
+# -------------------------------------------------------------- registry ----
+def _model(seed=0, k=200):
+    rng = np.random.default_rng(seed)
+    gram_map = {
+        g: rng.normal(size=2).tolist() for g in GRAM_MAP
+    }
+    return LanguageDetectorModel.from_gram_map(gram_map, (2, 3), LANGS)
+
+
+def test_registry_swap_exactly_one_version_zero_drops():
+    """Concurrent requests across a hot-swap: every request answered
+    exactly once, bit-identical to the direct scores of exactly one of
+    the two versions; no drops, no errors."""
+    model_a, model_b = _model(seed=1), _model(seed=2)
+    runner_a, runner_b = model_a._get_runner(), model_b._get_runner()
+    registry = ModelRegistry(drain_timeout_s=5.0)
+    v_a = registry.install(model_a)
+    rng = np.random.default_rng(23)
+    doc_sets = [_docs(rng, 3, max_len=40) for _ in range(40)]
+    results: list = [None] * len(doc_sets)
+    swapped = threading.Event()
+
+    with ContinuousBatcher(registry, max_wait_ms=2, max_rows=16) as b:
+        def work(i):
+            if i == 20:
+                swapped.set()
+            results[i] = b.submit(doc_sets[i]).result(timeout=30)
+
+        threads = [
+            threading.Thread(target=work, args=(i,))
+            for i in range(len(doc_sets))
+        ]
+        for t in threads[:20]:
+            t.start()
+        v_b = registry.install(model_b)
+        for t in threads[20:]:
+            t.start()
+        for t in threads:
+            t.join()
+
+    served = set()
+    for i, res in enumerate(results):
+        assert res is not None, f"request {i} dropped"
+        served.add(res.version)
+        runner = runner_a if res.version == v_a else runner_b
+        np.testing.assert_array_equal(res.values, runner.score(doc_sets[i]))
+    assert v_b in served  # the swap actually took traffic
+    versions = registry.versions()
+    assert [v["version"] for v in versions] == [v_a, v_b]
+    assert versions[1]["active"] and not versions[0]["active"]
+    assert versions[0]["retired"]
+
+
+def test_registry_rollback_and_history():
+    model_a, model_b = _model(seed=3), _model(seed=4)
+    registry = ModelRegistry(drain_timeout_s=1.0)
+    v_a = registry.install(model_a)
+    v_b = registry.install(model_b)
+    assert registry.current_version() == v_b
+    assert registry.rollback() == v_a
+    assert registry.current_version() == v_a
+    with pytest.raises(Exception, match="no previous version"):
+        registry.rollback()
+    docs = [b"abab", b"zz"]
+    with ContinuousBatcher(registry, max_wait_ms=2) as b:
+        got = b.submit(docs).result(timeout=30)
+    assert got.version == v_a
+    np.testing.assert_array_equal(
+        got.values, model_a._get_runner().score(docs)
+    )
+
+
+def test_registry_load_from_disk_and_duplicate_version(tmp_path):
+    """load() goes through persist.load_model; duplicate names refuse."""
+    model = _model(seed=5)
+    model.save(str(tmp_path / "m"))
+    registry = ModelRegistry()
+    v1 = registry.load(str(tmp_path / "m"))
+    assert registry.peek().source == str(tmp_path / "m")
+    assert registry.peek().languages == LANGS
+    with pytest.raises(Exception, match="already registered"):
+        registry.load(str(tmp_path / "m"), version=v1)
+    docs = [b"abab"]
+    with ContinuousBatcher(registry, max_wait_ms=2) as b:
+        got = b.submit(docs).result(timeout=30)
+    np.testing.assert_array_equal(
+        got.values, model._get_runner().score(docs)
+    )
+
+
+def test_registry_lease_pins_version_during_swap():
+    """A lease taken before a swap keeps serving its version; the next
+    lease sees the new one."""
+    model_a, model_b = _model(seed=6), _model(seed=7)
+    registry = ModelRegistry(drain_timeout_s=0.2)
+    v_a = registry.install(model_a)
+    with registry.lease() as entry:
+        v_b = registry.install(model_b)  # drain times out, swap proceeds
+        assert entry.version == v_a
+    with registry.lease() as entry:
+        assert entry.version == v_b
+
+
+# ------------------------------------------------------------------ http ----
+@pytest.fixture()
+def serving():
+    from spark_languagedetector_tpu.serve.client import ServeClient
+    from spark_languagedetector_tpu.serve.server import ServingServer
+
+    model = _model(seed=8)
+    registry = ModelRegistry()
+    registry.install(model)
+    server = ServingServer(
+        registry, port=0, max_wait_ms=2, max_rows=64
+    ).start()
+    client = ServeClient(*server.address)
+    try:
+        yield model, registry, server, client
+    finally:
+        server.stop()
+
+
+def test_http_score_bit_identical_and_detect(serving):
+    model, registry, server, client = serving
+    runner = model._get_runner()
+    texts = ["abab", "zz", "", "abczz"]
+    scores, meta = client.score(texts)
+    np.testing.assert_array_equal(scores, runner.score(texts_to_bytes(texts)))
+    assert meta["version"] == "v1"
+    assert meta["trace_id"]
+    labels, _ = client.detect(texts)
+    want_ids = runner.predict_ids(texts_to_bytes(texts))
+    assert labels == [LANGS[i] for i in want_ids]
+
+
+def test_http_healthz_varz(serving):
+    model, registry, server, client = serving
+    client.score(["abab"])
+    health = client.healthz()
+    assert health["ok"] and health["version"] == "v1"
+    assert health["breaker"] == "closed"
+    assert "queued_rows" in health["batcher"]
+    varz = client.varz()
+    assert "serve/dispatch" in varz["stages"]
+    assert any(k.startswith("serve/") for k in varz["histograms"])
+    assert varz["versions"][0]["version"] == "v1"
+
+
+def test_http_shed_is_503_with_retry_after(serving):
+    from spark_languagedetector_tpu.serve.client import ServeHTTPError
+
+    model, registry, server, client = serving
+    server.batcher.max_queue_rows = 1
+    # Occupy the dispatcher so the queue check actually sees a backlog.
+    with faults.plan_scope(FaultPlan.parse("seed=1;serve/admit:error@1")):
+        with pytest.raises(ServeHTTPError) as exc:
+            client.score(["abab"])
+    assert exc.value.status == 503
+    assert exc.value.shed
+    assert exc.value.retry_after_s > 0
+    server.batcher.max_queue_rows = 4096
+
+
+def test_http_bad_requests_are_400(serving):
+    from spark_languagedetector_tpu.serve.client import ServeHTTPError
+
+    model, registry, server, client = serving
+    with pytest.raises(ServeHTTPError) as exc:
+        client._request("POST", "/score", {"texts": "not-a-list"})
+    assert exc.value.status == 400
+    with pytest.raises(ServeHTTPError) as exc:
+        client._request("POST", "/score", {"texts": ["a"], "priority": "vip"})
+    assert exc.value.status == 400
+    with pytest.raises(ServeHTTPError) as exc:
+        client._request("POST", "/nope", {})
+    assert exc.value.status == 404
+
+
+def test_http_swap_and_rollback(serving, tmp_path):
+    model, registry, server, client = serving
+    model_b = _model(seed=9)
+    model_b.save(str(tmp_path / "m2"))
+    runner_b = model_b._get_runner()
+    v2 = client.swap(str(tmp_path / "m2"))
+    assert v2 == "v2"
+    texts = ["abab", "zz"]
+    scores, meta = client.score(texts)
+    assert meta["version"] == v2
+    np.testing.assert_array_equal(
+        scores, runner_b.score(texts_to_bytes(texts))
+    )
+    assert client.rollback() == "v1"
+    _, meta = client.score(texts)
+    assert meta["version"] == "v1"
+
+
+def test_http_low_byte_encoding_respected(tmp_path):
+    """The server encodes texts with the active model's predictEncoding."""
+    from spark_languagedetector_tpu.ops.encoding import LOW_BYTE
+    from spark_languagedetector_tpu.serve.client import ServeClient
+    from spark_languagedetector_tpu.serve.server import ServingServer
+
+    model = _model(seed=10)
+    model.set_predict_encoding(LOW_BYTE)
+    runner = model._get_runner()
+    with ServingServer(model, port=0, max_wait_ms=2) as server:
+        client = ServeClient(*server.address)
+        texts = ["abézz", "abab"]
+        scores, _ = client.score(texts)
+    np.testing.assert_array_equal(
+        scores, runner.score(texts_to_bytes(texts, LOW_BYTE))
+    )
+
+
+# ------------------------------------------------------- compare guard ------
+def _snapshot_events(total_p99, shed=0):
+    hist = {
+        "count": 50, "sum": 1.0, "min": 0.001, "max": total_p99,
+        "mean": 0.01, "p50": 0.01, "p90": 0.012, "p99": total_p99,
+    }
+    return [
+        {"event": "telemetry.span", "ts": 1.0, "path": "serve/dispatch",
+         "wall_s": 0.01},
+        {"event": "telemetry.snapshot", "ts": 2.0,
+         "histograms": {"serve/total_s": hist},
+         "counters": {"serve/shed_requests": shed,
+                      "serve/coalesced_rows": 1000}},
+    ]
+
+
+def test_compare_flags_serve_latency_and_shed_regressions():
+    """telemetry.compare: a serve/total_s p99 regression past threshold
+    fails, and a shed counter appearing over a zero baseline fails —
+    while the throughput counter serve/coalesced_rows never regresses."""
+    from spark_languagedetector_tpu.telemetry.compare import (
+        capture_stats,
+        compare_captures,
+    )
+
+    base = capture_stats(_snapshot_events(0.012))
+    assert "serve/coalesced_rows" not in base["counters"]
+    good = capture_stats(_snapshot_events(0.013))
+    _, regressions = compare_captures(base, good, threshold=0.25)
+    assert regressions == []
+    slow = capture_stats(_snapshot_events(0.050))
+    _, regressions = compare_captures(base, slow, threshold=0.25)
+    assert any("serve/total_s p99" in r for r in regressions)
+    shedding = capture_stats(_snapshot_events(0.012, shed=5))
+    _, regressions = compare_captures(base, shedding, threshold=0.25)
+    assert any("serve/shed_requests" in r for r in regressions)

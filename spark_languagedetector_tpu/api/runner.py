@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import score as score_ops
+from ..ops import score_fused
 from ..ops import score_hist
 from ..ops import score_pallas
 from ..ops.encoding import (
@@ -213,7 +214,17 @@ class BatchRunner:
     # replicated; GSPMD partitions the jitted scorer across all devices.
     # Mutually exclusive with `device`.
     mesh: object | None = None
-    strategy: str = "auto"  # 'auto'|'gather'|'onehot'|'pallas'|'hybrid'|'hist'
+    # 'auto'|'gather'|'onehot'|'pallas'|'hybrid'|'hist'|'fused'
+    strategy: str = "auto"
+    # Weight-table quantization for the fused strategy ('int8' | 'int16';
+    # None ⇒ f32 tiles). Implies strategy='fused' under 'auto'; the scores
+    # carry per-language dequantize scales (f32 accumulation — see the
+    # quantized tolerance class in docs/ARCHITECTURE.md).
+    quantization: str | None = None
+    # VMEM budget per streamed fused-table tile (None ⇒ the kernel default;
+    # docs/PERFORMANCE.md §7 knob table). Pallas double-buffers the tiles,
+    # so live VMEM is 2x this.
+    fused_tile_bytes: int | None = None
     # Ragged h2d transfer (chunk-aligned flat buffer + device-side unpack
     # gather; see ops.encoding.pack_ragged_numpy). None ⇒ on for
     # single-device dispatch. Ignored on a mesh — even if set True — since
@@ -322,42 +333,56 @@ class BatchRunner:
                 f"one of {ENCODINGS}"
             )
         if self.strategy not in (
-            "auto", "gather", "onehot", "pallas", "hybrid", "hist"
+            "auto", "gather", "onehot", "pallas", "hybrid", "hist", "fused"
         ):
             raise ValueError(
                 f"unknown strategy {self.strategy!r}; expected 'auto', "
-                "'gather', 'onehot', 'pallas', 'hybrid', or 'hist'"
+                "'gather', 'onehot', 'pallas', 'hybrid', 'hist', or 'fused'"
+            )
+        if self.quantization not in (None, *score_fused.QUANT_DTYPES):
+            raise ValueError(
+                f"unknown quantization {self.quantization!r}; expected one "
+                f"of {tuple(score_fused.QUANT_DTYPES)} or None"
             )
         pallas_ok = self.lut is None and score_pallas.pallas_supported(
             self.spec, self.weights.shape[0], self.weights.shape[1]
         )
         hybrid_ok = self._hybrid_supported()
+        fused_ok = score_fused.fused_supported(
+            self.spec, self.weights.shape[0], self.weights.shape[1],
+            lut=self.lut, cuckoo=self.cuckoo,
+        )
+        if self.quantization is not None and self.strategy not in (
+            "auto", "fused"
+        ):
+            raise ValueError(
+                "quantization applies to the fused strategy only; got "
+                f"strategy={self.strategy!r}"
+            )
         if self.strategy == "auto":
-            # Fused/histogram pallas kernel on real accelerators when the
-            # whole vocab qualifies (exact grams ⊆ {1,2}, dense table);
-            # hybrid (pallas for n ≤ 2 + gather for n ≥ 3) when an exact
-            # vocab has longer grams — the short lengths carry most of the
-            # window count, and moving them off the gather path measured
-            # ~2.8× on the 50-language n=1..3 config; one-hot MXU via XLA
-            # otherwise-qualifying on CPU (pallas interpret mode is far too
-            # slow outside tests); gather fallback. On a mesh the XLA
-            # strategies partition via GSPMD and the pallas kernel runs
-            # per-shard under shard_map — all strategies qualify.
-            target = self._target_device()
-            if pallas_ok and target.platform == "tpu":
-                self.strategy = "pallas"
-            elif hybrid_ok and target.platform == "tpu":
-                self.strategy = "hybrid"
-            elif target.platform == "tpu" and self._hist_supported():
-                # Long-gram-only vocabs with membership: the row-histogram
-                # strategy beats the gather path ~10x (see ops.score_hist).
-                self.strategy = "hist"
-            elif self.lut is None and score_ops.onehot_supported(
-                self.spec, self.weights.shape[0]
-            ):
-                self.strategy = "onehot"
-            else:
-                self.strategy = "gather"
+            self.strategy, self.strategy_reason = self._auto_select(
+                self._target_device().platform, fused_ok, pallas_ok,
+                hybrid_ok,
+            )
+        else:
+            self.strategy_reason = "explicit"
+        # The auto branch used to be silent; a deployment debugging "why
+        # did this land on gather?" now gets the answer in the log AND on
+        # every score span (telemetry/report shows span attrs).
+        log_event(
+            _log,
+            "runner.strategy",
+            strategy=self.strategy,
+            reason=self.strategy_reason,
+            platform=self._target_device().platform,
+            quantization=self.quantization,
+        )
+        if self.strategy == "fused" and not fused_ok:
+            raise ValueError(
+                "strategy='fused' needs dense or LUT membership (exact "
+                "gram lengths <= 3, or a hashed vocab); packed-key cuckoo "
+                "profiles use the hybrid/hist strategies"
+            )
         if self.strategy == "onehot" and not score_ops.onehot_supported(
             self.spec, self.weights.shape[0]
         ):
@@ -381,7 +406,7 @@ class BatchRunner:
                 "table or an id->row LUT)"
             )
         if self.batch_size is None:
-            if self.strategy == "pallas":
+            if self.strategy in ("pallas", "fused"):
                 self.batch_size = DEFAULT_PALLAS_BATCH_SIZE
             elif self.strategy in ("hybrid", "hist"):
                 heavy = any(n >= 4 for n in self.spec.gram_lengths)
@@ -428,6 +453,177 @@ class BatchRunner:
             and any(n <= 2 for n in glens)
             and any(n > 2 for n in glens)
         )
+
+    def _auto_select(
+        self, platform: str, fused_ok: bool, pallas_ok: bool,
+        hybrid_ok: bool,
+    ) -> tuple[str, str]:
+        """(strategy, reason) for strategy='auto'.
+
+        On a TPU backend the fused megakernel is preferred wherever it
+        covers the profile form (ROADMAP item 3): one program, no
+        intermediate HBM round-trips, quantized table tiles. The previous
+        ranking (pallas → hybrid → hist) stays as the ladder beneath it —
+        and remains reachable explicitly for A/B. CPU keeps the XLA
+        strategies: interpret-mode pallas is for tests, not serving.
+        """
+        if self.quantization is not None:
+            if not fused_ok:
+                raise ValueError(
+                    "quantization needs the fused strategy, which does not "
+                    "support this profile form (cuckoo membership?)"
+                )
+            return "fused", "quantization requested ⇒ fused table tiles"
+        if platform == "tpu":
+            if fused_ok:
+                return "fused", (
+                    "tpu + dense/LUT membership ⇒ fused megakernel"
+                )
+            if pallas_ok:
+                return "pallas", "tpu + exact short-gram dense table"
+            if hybrid_ok:
+                return "hybrid", (
+                    "tpu + exact short-gram ids with long grams ⇒ pallas "
+                    "histogram for n<=2, gather/hist for the rest"
+                )
+            if self._hist_supported():
+                return "hist", (
+                    "tpu + compact-row membership ⇒ row-histogram MXU path"
+                )
+        if self.lut is None and score_ops.onehot_supported(
+            self.spec, self.weights.shape[0]
+        ):
+            return "onehot", (
+                f"{platform} + exact short-gram dense table ⇒ one-hot MXU "
+                "via XLA (pallas interpret mode is test-only off-TPU)"
+            )
+        return "gather", f"{platform} fallback: gather/LUT dispatch"
+
+    def _fused_state(self):
+        """(interpret, tables) for the fused strategy — the quantized tile
+        layout is real relayout work, built once per runner."""
+        state = getattr(self, "_fused_cache", None)
+        if state is None:
+            with self._state_lock:
+                return self._fused_state_locked()
+        return state
+
+    def _fused_state_locked(self):
+        state = getattr(self, "_fused_cache", None)
+        if state is None:
+            # Re-validate: strategy may have been mutated post-construction.
+            if not score_fused.fused_supported(
+                self.spec, self.weights.shape[0], self.weights.shape[1],
+                lut=self.lut, cuckoo=self.cuckoo,
+            ):
+                raise ValueError(
+                    "strategy='fused' needs dense or LUT membership (exact "
+                    "gram lengths <= 3, or a hashed vocab)"
+                )
+            ft = score_fused.build_fused_tables(
+                np.asarray(self.weights),
+                None if self.lut is None else np.asarray(self.lut),
+                self.spec,
+                quantization=self.quantization,
+                tile_bytes=(
+                    self.fused_tile_bytes or score_fused.DEFAULT_TILE_BYTES
+                ),
+            )
+            wq = jnp.asarray(ft.wq)
+            scales = jnp.asarray(ft.scales)
+            lut_f = None if ft.lut is None else jnp.asarray(ft.lut)
+            if self.mesh is not None:
+                from ..parallel.mesh import replicated
+
+                placement = replicated(self.mesh)
+            else:
+                placement = self.device
+            if placement is not None:
+                wq = jax.device_put(wq, placement)
+                scales = jax.device_put(scales, placement)
+                if lut_f is not None:
+                    lut_f = jax.device_put(lut_f, placement)
+            interpret = self._target_device().platform != "tpu"
+            state = self._fused_cache = (
+                interpret, ft.layout, wq, scales, lut_f, ft.table_bytes,
+                ft.f32_bytes,
+            )
+        return state
+
+    def _mesh_fused_fn(self, interpret: bool):
+        """shard_map wrapper running the fused kernel per data shard
+        (pallas_call has no GSPMD partitioning rule; tables replicated,
+        batch split over the data axis — the same compiled program scales
+        across the mesh unchanged)."""
+        fn = getattr(self, "_mesh_fused_cache", None)
+        if fn is None:
+            from jax.sharding import PartitionSpec as P
+
+            from ..parallel.mesh import DATA_AXIS, shard_map_compat
+
+            _, layout, _, _, lut_f, _, _ = self._fused_state()
+            block = self.pallas_block or score_fused.DEFAULT_BLOCK
+            has_lut = lut_f is not None
+
+            def local(batch, lengths, wq, scales, lut, lim):
+                return score_fused.score_batch_fused(
+                    batch, lengths, wq, scales,
+                    lut if has_lut else None, lim,
+                    spec=self.spec, layout=layout, block=block,
+                    interpret=interpret,
+                )
+
+            fn = self._mesh_fused_cache = jax.jit(
+                shard_map_compat(
+                    local,
+                    mesh=self.mesh,
+                    in_specs=(
+                        P(DATA_AXIS), P(DATA_AXIS), P(), P(), P(),
+                        P(DATA_AXIS),
+                    ),
+                    out_specs=P(DATA_AXIS),
+                    check_vma=False,
+                )
+            )
+        return fn
+
+    def _fused_scores(self, batch, lengths, window_limit, placement):
+        """Fused-megakernel scoring on one packed batch — single device,
+        or per data shard under shard_map on a mesh."""
+        interpret, layout, wq, scales, lut_f, _, _ = self._fused_state()
+        if self.mesh is not None:
+            if window_limit is None:
+                window_limit = self._full_limit(batch.shape[0], placement)
+            lut_arg = (
+                lut_f if lut_f is not None
+                else jnp.zeros(0, jnp.int32)  # shard_map needs a leaf
+            )
+            return self._mesh_fused_fn(interpret)(
+                batch, lengths, wq, scales, lut_arg, window_limit
+            )
+        return score_fused.score_batch_fused(
+            batch, lengths, wq, scales, lut_f, window_limit,
+            spec=self.spec, layout=layout,
+            block=self.pallas_block or score_fused.DEFAULT_BLOCK,
+            interpret=interpret,
+        )
+
+    def table_bytes(self) -> int:
+        """Resident weight-side bytes of the active strategy's device form
+        (the telemetry ``langdetect_table_bytes`` gauge; the compare guard
+        tracks it so a change that silently de-quantizes or re-balloons
+        table traffic fails the diff)."""
+        if self.strategy == "fused":
+            _, _, _, _, _, table_bytes, _ = self._fused_state()
+            return int(table_bytes)
+        total = int(np.prod(self.weights.shape)) * int(
+            np.dtype(self.weights.dtype).itemsize
+        )
+        if self.lut is not None:
+            total += int(self.lut.size) * 4
+        if self.cuckoo is not None:
+            total += int(self._cuckoo_entries.size) * 4
+        return total
 
     def _hybrid_state(self):
         """(interpret, spec12, w1, w2, rest_lengths) for the hybrid strategy.
@@ -547,7 +743,7 @@ class BatchRunner:
         if fn is None:
             from jax.sharding import PartitionSpec as P
 
-            from ..parallel.mesh import DATA_AXIS
+            from ..parallel.mesh import DATA_AXIS, shard_map_compat
 
             block = self.pallas_block or score_pallas.DEFAULT_BLOCK
 
@@ -558,7 +754,7 @@ class BatchRunner:
                 )
 
             fn = cache[(spec, interpret)] = jax.jit(
-                jax.shard_map(
+                shard_map_compat(
                     local,
                     mesh=self.mesh,
                     in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P(), P(DATA_AXIS)),
@@ -651,7 +847,7 @@ class BatchRunner:
         if fn is None:
             from jax.sharding import PartitionSpec as P
 
-            from ..parallel.mesh import DATA_AXIS
+            from ..parallel.mesh import DATA_AXIS, shard_map_compat
 
             wp, rhi, interpret, bucket_dev, bucket_seed, kind = (
                 self._hist_state()
@@ -673,7 +869,7 @@ class BatchRunner:
                 )
 
             fn = cache[gram_lengths_subset] = jax.jit(
-                jax.shard_map(
+                shard_map_compat(
                     local,
                     mesh=self.mesh,
                     in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P(DATA_AXIS)),
@@ -848,6 +1044,8 @@ class BatchRunner:
         # compiled fast path and the degraded ladder's device level both
         # count as device dispatches).
         faults.inject("score/dispatch")
+        if self.strategy == "fused":
+            return self._fused_scores(batch, lengths, window_limit, placement)
         if self.strategy == "pallas":
             interpret, w1, w2 = self._pallas_state()
             return self._pallas_dispatch(
@@ -990,7 +1188,12 @@ class BatchRunner:
             limit_np = np.asarray(batch_limits, dtype=np.int32)
         batch_np, lengths_np = self._pack(batch_docs, pad_to)
         levels = ["host"]
-        if self.strategy in ("pallas", "hybrid", "hist"):
+        if self.strategy in ("fused", "pallas", "hybrid", "hist"):
+            # The fused megakernel sits at the top of the ladder: a
+            # retryable kernel failure falls fused → device gather → host,
+            # exact at every rung (the gather escape reads the runner's
+            # original f32 weights/LUT, so degraded results never carry
+            # quantization error).
             levels.insert(0, "gather")
         last = cause
         for level in levels:
@@ -1366,7 +1569,8 @@ class BatchRunner:
         # slow request can be isolated from the aggregate percentiles.
         with trace_request() as req_id, trace(label="score"), \
                 self.metrics.timer("score_s"), span(
-            "score", docs=N, batches=len(plan), strategy=self.strategy
+            "score", docs=N, batches=len(plan), strategy=self.strategy,
+            strategy_reason=getattr(self, "strategy_reason", "explicit"),
         ) as score_span:
             if workers > 1:
                 from concurrent.futures import ThreadPoolExecutor

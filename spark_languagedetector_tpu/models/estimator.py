@@ -118,6 +118,15 @@ class _DetectorParams(HasInputCol, HasLabelCol):
         "estimator-configures-model flow works in one place",
         lambda v: v in BACKENDS,
     )
+    quantization = Param(
+        "quantization",
+        "weight-table quantization stamped onto the fitted model "
+        "(LanguageDetectorModel.quantization): 'int8' | 'int16' ship the "
+        "fused detect kernel int8/int16 table tiles with per-language f32 "
+        "scales (f32 accumulation; docs/PERFORMANCE.md §7); None keeps "
+        "f32 tables",
+        lambda v: v in (None, "int8", "int16"),
+    )
 
 
 class LanguageDetector(_DetectorParams):
@@ -180,6 +189,9 @@ class LanguageDetector(_DetectorParams):
 
     def set_backend(self, value: str):
         return self.set("backend", value)
+
+    def set_quantization(self, value: str | None):
+        return self.set("quantization", value)
 
     def set_vocab_mode(self, mode: str):
         return self.set("vocabMode", mode)
@@ -296,6 +308,8 @@ class LanguageDetector(_DetectorParams):
         model.set_default(inputCol=self.get_or_default("inputCol"))
         if self.is_set("backend"):
             model.set("backend", self.get("backend"))
+        if self.is_set("quantization"):
+            model.set("quantization", self.get("quantization"))
         return model
 
     def _fit_profile(self, spec, docs, lang_idx, supported):
@@ -381,6 +395,17 @@ class LanguageDetectorModel(HasInputCol, HasOutputCol):
         "micro-batch rows per device dispatch; None ⇒ auto per strategy",
         lambda v: v is None or _positive_int(v),
     )
+    quantization = Param(
+        "quantization",
+        "'int8' | 'int16': score through the fused detect kernel with a "
+        "quantized weight table (per-language f32 scales, f32 "
+        "accumulation) — ~4x/2x fewer table bytes streamed per dispatch "
+        "at a bounded argmax-agreement cost (docs/ARCHITECTURE.md "
+        "quantized tolerance class; bench gates int16 at exact argmax "
+        "parity, int8 at >= 0.999 agreement). None (default) keeps f32 "
+        "tables and the strategy auto-select",
+        lambda v: v in (None, "int8", "int16"),
+    )
     max_score_bytes = Param(
         "maxScoreBytes",
         "score only the first N bytes of each document (UTF-8-boundary-"
@@ -403,6 +428,7 @@ class LanguageDetectorModel(HasInputCol, HasOutputCol):
             backend=BACKEND_AUTO,
             batchSize=None,
             maxScoreBytes=None,
+            quantization=None,
         )
         self._runner: BatchRunner | None = None
         # Concurrent transforms (the streaming engine runs >1 transform
@@ -437,6 +463,9 @@ class LanguageDetectorModel(HasInputCol, HasOutputCol):
 
     def set_max_score_bytes(self, value: int | None):
         return self.set("maxScoreBytes", value)
+
+    def set_quantization(self, value: str | None):
+        return self.set("quantization", value)
 
     # -- reference accessors ---------------------------------------------------
     @property
@@ -536,6 +565,7 @@ class LanguageDetectorModel(HasInputCol, HasOutputCol):
                     cuckoo=cuckoo,
                     spec=self.profile.spec,
                     batch_size=self.get("batchSize"),
+                    quantization=self.get("quantization"),
                     device=(
                         None if mesh is not None else resolve_device(backend)
                     ),
@@ -598,9 +628,18 @@ class _ModelWriter:
         self._model = model
         self._overwrite = False  # MLWriter contract: destructive only after .overwrite()
         self._layout = "native"
+        self._quantize: str | None = None
 
     def overwrite(self) -> "_ModelWriter":
         self._overwrite = True
+        return self
+
+    def quantized(self, dtype: str = "int8") -> "_ModelWriter":
+        """Store the weight table quantized ('int8' | 'int16'): integer
+        parquet rows + per-language f32 scales in the metadata — 4x/2x
+        less disk, save/load-stable fused quantized scores (native layout
+        only; see persist.io.save_model)."""
+        self._quantize = dtype
         return self
 
     def reference_layout(self) -> "_ModelWriter":
@@ -621,4 +660,5 @@ class _ModelWriter:
             self._model.param_metadata(),
             overwrite=self._overwrite,
             layout=self._layout,
+            quantize=self._quantize,
         )

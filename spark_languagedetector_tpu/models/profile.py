@@ -37,6 +37,48 @@ from ..ops.vocab import EXACT, HASHED, VocabSpec
 # L=3 (202MB) passing, the hashed 2^20 table at L=176 (738MB f32) compacting.
 DENSE_TABLE_BUDGET_BYTES = 256 * 1024 * 1024
 
+# Quantized weight-table dtypes (the fused detect kernel's storage option):
+# name -> (numpy dtype, symmetric integer range). Scales are per-language
+# f32: w[r, l] ≈ q[r, l] * scale[l], so the dequantize multiply factors out
+# of the window sum and is applied once per (doc, language) — accumulation
+# stays f32 over exact integer products (docs/ARCHITECTURE.md tolerance
+# classes).
+QUANT_DTYPES: dict[str, tuple[str, int]] = {
+    "int8": ("int8", 127),
+    "int16": ("int16", 32767),
+}
+
+
+def quantize_weights(
+    weights: np.ndarray, dtype: str
+) -> tuple[np.ndarray, np.ndarray]:
+    """Symmetric per-language absmax quantization: (q [R, L], scales [L]).
+
+    ``q = rint(w / scale)`` with ``scale[l] = absmax(w[:, l]) / qmax``
+    (all-zero columns get scale 1.0 so dequantize is total). Deterministic
+    (``np.rint`` half-to-even), and a fixed point of
+    quantize∘dequantize: requantizing ``q * scale`` returns ``q`` exactly,
+    which is what makes the persisted int8/int16 form round-trip to
+    bit-identical quantized scores (pinned by tests/test_score_fused.py).
+    """
+    if dtype not in QUANT_DTYPES:
+        raise ValueError(
+            f"unknown quantization dtype {dtype!r}; expected one of "
+            f"{tuple(QUANT_DTYPES)}"
+        )
+    np_dtype, qmax = QUANT_DTYPES[dtype]
+    w = np.asarray(weights, dtype=np.float32)
+    absmax = np.abs(w).max(axis=0) if w.size else np.zeros(w.shape[1])
+    scales = np.where(absmax > 0, absmax / qmax, 1.0).astype(np.float32)
+    q = np.clip(np.rint(w / scales), -qmax, qmax).astype(np_dtype)
+    return q, scales
+
+
+def dequantize_weights(q: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    """float32 [R, L] reconstruction ``q * scale`` (exact in f32 — the
+    products are small integers times one float)."""
+    return q.astype(np.float32) * np.asarray(scales, dtype=np.float32)
+
 
 @dataclass(frozen=True)
 class GramProfile:

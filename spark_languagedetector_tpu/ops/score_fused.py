@@ -1,0 +1,762 @@
+"""One fused detect megakernel: window → hash → lookup → accumulate → argmax.
+
+ROADMAP item 3 ("close the compute ceiling"). The strategies this replaces
+split the per-document pipeline across several XLA programs with HBM
+round-trips between them: the gather path reads an L-wide table row per
+window (~7MB/doc of table traffic at the hashed-2^20 / 176-language config —
+the roofline gauges say that program is memory-bound on table reads), and
+the hist path (:mod:`ops.score_hist`) fixes the gather but writes a ~287KB
+per-document row histogram to HBM and reads it back for the ``hist @ W``
+contraction. This kernel runs the whole chain in ONE ``pallas_call``:
+
+  * **window → hash in-kernel**: window ids are computed on the VPU from
+    pre-shifted byte planes — exact/exact12 polynomial ids for short grams,
+    and the FNV-1a fold for hashed vocabs (the same host-side hash in
+    :mod:`ops.vocab`, wrapping int32 arithmetic; the non-power-of-two
+    ``exact12`` fold modulus is reduced with a float-reciprocal quotient +
+    two correction steps, exact for 32-bit inputs);
+  * **table lookup + accumulate on the MXU**: per document a digit-decomposed
+    row histogram (``r = hi*256 + lo``; two one-hots, one NT matmul per
+    window block — the :mod:`ops.score_hist` formulation) is built in VMEM
+    scratch *per table tile* and immediately contracted with that tile of
+    the (quantized) weight table. The table streams through VMEM in
+    ``[tile_hi*256, Lpad]`` tiles on the inner grid axis, so Pallas's
+    pipeline machinery double-buffers the HBM→VMEM tile fetches behind the
+    compute; the histogram never exists in HBM;
+  * **quantized weights, f32 accumulation**: int8/int16 tables with
+    per-language f32 scales (:func:`models.profile.quantize_weights`) cut
+    the streamed table bytes 4×/2×; counts and integer weights are exact in
+    f32, the scale multiplies once per (doc, language) at the end;
+  * **argmax in-kernel**: the detect variant emits one (label, best-score)
+    pair per document — first-maximum tie-breaking, all-miss docs argmax to
+    0 (SURVEY.md §2.9) — so per document the only HBM traffic is the byte
+    row in, the streamed table tiles, and 8 bytes out.
+
+The one stage Mosaic cannot fuse is compact-row *membership*: an id→row
+gather does not lower in-kernel (the same constraint documented in
+:mod:`ops.score_hist`), so profiles that ship a LUT resolve window rows in
+XLA inside the same jit and pass them as an int32 plane. The ``exact12``
+hashed scheme splits the difference: its short-gram buckets [0, 65792) ARE
+exact polynomial ids, so the fused table is laid out [dense short-gram
+region ∥ compact long-gram rows] and only gram lengths ≥ 3 need the row
+plane — the bulk of the window count hashes fully in-kernel even for the
+hashed-2^20 production config.
+
+Parity contract (docs/ARCHITECTURE.md §tolerance classes): unquantized
+scores match the gather reference up to f32 reduction order with exact
+argmax on the bench suites; quantized scores carry the per-language scale
+rounding (bench gates: int16 argmax parity 1.0, int8 agreement ≥ 0.999).
+CPU substrates run the kernel in Pallas interpret mode (tier-1 pins the
+semantics without hardware).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..models.profile import QUANT_DTYPES, quantize_weights
+from .score import _splice_partial_windows
+from .score_pallas import COMPILER_PARAMS
+from .vocab import (
+    _EXACT12_BASE,
+    _FNV_OFFSET,
+    _FNV_PRIME,
+    _SHORT_GRAM_OFFSETS,
+    EXACT,
+    EXACT12,
+    HASHED,
+    MAX_DEVICE_ID_GRAM_LEN,
+    VocabSpec,
+    partial_window_ids,
+    window_ids,
+)
+
+# Documents per grid step (sublane tile height of the byte/row planes).
+DB = 8
+
+# Window-axis block: lane dimension of the one-hots (MXU contraction depth).
+DEFAULT_BLOCK = 2048
+
+# Streamed table tile budget. The tile is [tile_hi*256, Lpad] rows of the
+# quantized table resident in VMEM; Pallas double-buffers it, so the live
+# footprint is 2x this. 2MB keeps the whole kernel (planes + one-hots +
+# tile pair + histogram scratch) under ~6MB of VMEM at the production
+# shapes — see docs/PERFORMANCE.md §7 for the knob table.
+DEFAULT_TILE_BYTES = 2 << 20
+
+# Kernel-side FNV-1a constants as wrapping int32 (bit-identical to the
+# uint32 host arithmetic in ops.vocab for xor/multiply/shift).
+_FNV_OFFSET_I32 = int(np.int32(np.uint32(_FNV_OFFSET)))
+_FNV_PRIME_I32 = int(_FNV_PRIME)
+
+# Inline window-id kinds (FusedLayout.inline entries).
+POLY = "poly"  # p1 = id-space offset of the length's region
+FNV_MASK = "fnv_mask"  # p1 = 2^hash_bits - 1
+FNV_FOLD = "fnv_fold"  # p1 = fold base (_EXACT12_BASE), p2 = fold modulus
+
+
+@dataclass(frozen=True)
+class FusedLayout:
+    """Static shape/plan of one fused program (hashable — a jit static).
+
+    ``inline``: per gram length scored from byte planes in-kernel:
+    ``(n, kind, p1, p2)``. ``rows_lengths``: gram lengths whose compact rows
+    are resolved by XLA membership and passed as an int32 plane. ``rows`` is
+    the real row count of the fused table (pre-padding); the table streams
+    in ``tiles`` tiles of ``tile_hi`` hi-digits (256 rows each).
+    """
+
+    inline: tuple[tuple[int, str, int, int], ...]
+    rows_lengths: tuple[int, ...]
+    rows: int
+    tile_hi: int
+    tiles: int
+    lpad: int
+    n_langs: int
+    quant: str | None
+
+    @property
+    def rows_padded(self) -> int:
+        return self.tiles * self.tile_hi * 256
+
+    @property
+    def max_inline(self) -> int:
+        return max((n for n, _, _, _ in self.inline), default=0)
+
+
+@dataclass(frozen=True)
+class FusedTables:
+    """Host-built operands of the fused program (one per profile form).
+
+    ``wq`` [rows_padded, lpad] quantized (or f32) table; ``scales``
+    [8, lpad] f32 per-language scales (row-replicated for the sublane
+    tile); ``lut`` int32 [id_space] fused-row membership or None when every
+    length is inline; ``table_bytes`` counts the real (unpadded) quantized
+    rows, ``f32_bytes`` the same rows at f32 — the bench's table_bytes
+    ratio.
+    """
+
+    layout: FusedLayout
+    wq: np.ndarray
+    scales: np.ndarray
+    lut: np.ndarray | None
+    table_bytes: int
+    f32_bytes: int
+
+
+def fused_supported(
+    spec: VocabSpec, num_rows: int, num_langs: int, *, lut, cuckoo
+) -> bool:
+    """True when the fused kernel covers this profile form: dense tables
+    (exact gram lengths ≤ 3 / hashed any scheme) and LUT-compact profiles.
+    Packed-key cuckoo membership (exact gram lengths 4..5) stays on the
+    hybrid/hist strategies — its two-probe verify has no in-kernel analog
+    and no int32 id plane exists for those lengths."""
+    if cuckoo is not None:
+        return False
+    if spec.mode == EXACT and max(spec.gram_lengths) > MAX_DEVICE_ID_GRAM_LEN:
+        return False
+    return True
+
+
+def _hashed_inline_entry(spec: VocabSpec, n: int) -> tuple[int, str, int, int]:
+    """Inline plan entry for gram length ``n`` of a hashed vocab whose
+    buckets index the fused table directly."""
+    if spec.hash_scheme == EXACT12 and n <= 2:
+        return (n, POLY, _SHORT_GRAM_OFFSETS[n], 0)
+    if spec.hash_scheme == EXACT12:
+        return (n, FNV_FOLD, _EXACT12_BASE, spec._fold_modulus)
+    return (n, FNV_MASK, (1 << spec.hash_bits) - 1, 0)
+
+
+def _tile_hi(lpad: int, itemsize: int, tile_bytes: int) -> int:
+    """Hi-digits per streamed table tile under the VMEM tile budget,
+    sublane-friendly (multiple of 8, at least 8)."""
+    ht = tile_bytes // (256 * lpad * itemsize)
+    return max(8, (ht // 8) * 8)
+
+
+def build_fused_tables(
+    weights,
+    lut,
+    spec: VocabSpec,
+    quantization: str | None = None,
+    tile_bytes: int = DEFAULT_TILE_BYTES,
+) -> FusedTables:
+    """Fused-table layout + operands from a runner's device view.
+
+    Dense tables (``lut`` None): every window id is its own row — all gram
+    lengths inline. LUT-compact exact12 profiles: the short-gram bucket
+    region [0, 65792) is re-materialized dense (rows = bucket ids, the
+    hybrid strategy's ``dense12`` trick) so gram lengths ≤ 2 stay inline,
+    and the long-gram buckets remap into compact rows appended after it.
+    Everything else resolves every length through the (re-based) LUT in
+    XLA. Call once per profile — the quantize + relayout is real work.
+    """
+    w = np.asarray(weights, dtype=np.float32)
+    R0, L = w.shape
+    lut_np = None if lut is None else np.asarray(lut)
+    if lut_np is not None and lut_np.size == 0:
+        lut_np = None
+
+    if lut_np is None:
+        if spec.mode == EXACT:
+            if max(spec.gram_lengths) > MAX_DEVICE_ID_GRAM_LEN:
+                raise ValueError(
+                    "fused kernel: exact gram lengths > "
+                    f"{MAX_DEVICE_ID_GRAM_LEN} have no int32 id plane"
+                )
+            if R0 != spec.id_space_size:
+                raise ValueError(
+                    "fused kernel: dense exact table must cover the id "
+                    f"space ({spec.id_space_size} rows, got {R0})"
+                )
+            inline = tuple(
+                (n, POLY, spec.offsets[n], 0) for n in spec.gram_lengths
+            )
+        else:
+            if R0 != spec.id_space_size:
+                raise ValueError(
+                    "fused kernel: dense hashed table must cover the bucket "
+                    f"space ({spec.id_space_size} rows, got {R0})"
+                )
+            inline = tuple(
+                _hashed_inline_entry(spec, n) for n in spec.gram_lengths
+            )
+        rows_lengths: tuple[int, ...] = ()
+        table = w
+        lut_fused = None
+    elif (
+        spec.mode == HASHED
+        and spec.hash_scheme == EXACT12
+        and any(n <= 2 for n in spec.gram_lengths)
+    ):
+        short = tuple(n for n in spec.gram_lengths if n <= 2)
+        long = tuple(n for n in spec.gram_lengths if n > 2)
+        # Rows = [dense short-gram region | compact long-gram rows]: short
+        # buckets become their own row index (in-kernel polynomial ids, no
+        # membership), long buckets remap into the rows they actually hit.
+        dense12 = w[lut_np[:_EXACT12_BASE]]
+        inline = tuple((n, POLY, _SHORT_GRAM_OFFSETS[n], 0) for n in short)
+        rows_lengths = long
+        if long:
+            long_refs = lut_np[_EXACT12_BASE:]
+            long_rows = np.unique(long_refs)
+            rank = np.zeros(R0, dtype=np.int64)
+            rank[long_rows] = np.arange(len(long_rows))
+            lut_fused = np.empty(spec.id_space_size, dtype=np.int32)
+            # Short buckets stay identity: long-gram *partial* windows are
+            # 1-2 byte prefixes whose buckets land in the short region.
+            lut_fused[:_EXACT12_BASE] = np.arange(_EXACT12_BASE)
+            lut_fused[_EXACT12_BASE:] = (
+                _EXACT12_BASE + rank[long_refs]
+            ).astype(np.int32)
+            table = np.concatenate([dense12, w[long_rows]])
+        else:
+            lut_fused = None
+            table = dense12
+    else:
+        inline = ()
+        rows_lengths = spec.gram_lengths
+        table = w
+        lut_fused = lut_np.astype(np.int32)
+
+    R, _ = table.shape
+    f32_bytes = R * L * 4
+    if quantization is not None:
+        q, scales_l = quantize_weights(table, quantization)
+        np_dtype, _ = QUANT_DTYPES[quantization]
+        itemsize = np.dtype(np_dtype).itemsize
+    else:
+        q, scales_l = table, np.ones(L, dtype=np.float32)
+        itemsize = 4
+    table_bytes = R * L * itemsize
+
+    lpad = max(128, -(-L // 128) * 128)
+    ht = _tile_hi(lpad, itemsize, tile_bytes)
+    rhi = -(-R // 256)
+    tiles = max(1, -(-rhi // ht))
+    rpad = tiles * ht * 256
+    wq = np.zeros((rpad, lpad), dtype=q.dtype)
+    wq[:R, :L] = q
+    scales = np.zeros((8, lpad), dtype=np.float32)
+    scales[:, :L] = scales_l
+
+    layout = FusedLayout(
+        inline=inline,
+        rows_lengths=rows_lengths,
+        rows=R,
+        tile_hi=ht,
+        tiles=tiles,
+        lpad=lpad,
+        n_langs=L,
+        quant=quantization,
+    )
+    return FusedTables(layout, wq, scales, lut_fused, table_bytes, f32_bytes)
+
+
+# --------------------------------------------------------------- kernel ----
+
+
+def _build_fused_kernel(
+    S: int,
+    KW: int,
+    wseg: int,
+    blk: int,
+    layout: FusedLayout,
+    want_labels: bool,
+):
+    """Kernel over grid (doc blocks, table tiles); table tiles stream on
+    the inner axis (Pallas double-buffers the HBM→VMEM fetch), byte/row
+    planes stay resident across a doc block's tiles (their block index is
+    tile-invariant)."""
+    HT, T = layout.tile_hi, layout.tiles
+    Lpad, n_langs = layout.lpad, layout.n_langs
+    has_inline = bool(layout.inline)
+    has_rows = bool(layout.rows_lengths)
+    n_steps = S // blk if has_inline else 0
+    n_rsteps = KW // blk if has_rows else 0
+
+    def kernel(*refs):
+        it = iter(refs)
+        bytes_ref = next(it) if has_inline else None
+        rows_ref = next(it) if has_rows else None
+        len_ref = next(it)
+        lim_ref = next(it)
+        prow_ref = next(it) if has_inline else None
+        wq_ref = next(it)
+        scale_ref = next(it)
+        out_ref = next(it)
+        if want_labels:
+            label_ref = next(it)
+            best_ref = next(it)
+        hist_ref = next(it)
+        acc_ref = next(it)
+
+        b = pl.program_id(0)
+        t = pl.program_id(1)
+        base = b * DB
+        tile_base = t * HT  # first hi-digit this tile covers
+
+        @pl.when(t == 0)
+        def _init():
+            acc_ref[:, :] = jnp.zeros((DB, Lpad), jnp.float32)
+
+        for d in range(DB):
+            dlen = len_ref[base + d]
+            dlim = lim_ref[base + d]
+            hist_ref[:, :] = jnp.zeros((HT, 256), jnp.float32)
+
+            def accumulate(ids, mask):
+                """One window block's [HT, 256] histogram contribution:
+                tile-local hi one-hot (masked) × lo one-hot, NT matmul."""
+                iota_hi = jax.lax.broadcasted_iota(jnp.int32, (HT, blk), 0)
+                iota_lo = jax.lax.broadcasted_iota(jnp.int32, (256, blk), 0)
+                hi_loc = (ids >> 8) - tile_base
+                lo = ids & 255
+                oh_hi = jnp.where(
+                    (hi_loc == iota_hi) & mask, 1.0, 0.0
+                ).astype(jnp.bfloat16)
+                oh_lo = jnp.where(lo == iota_lo, 1.0, 0.0).astype(
+                    jnp.bfloat16
+                )
+                hist_ref[:, :] += jax.lax.dot_general(
+                    oh_hi, oh_lo, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+
+            if has_inline:
+                for j, (n, kind, p1, p2) in enumerate(layout.inline):
+                    for k in range(n_steps):
+                        off = k * blk
+
+                        def step(off=off, k=k, n=n, kind=kind, p1=p1,
+                                 p2=p2, j=j):
+                            # window → id, in-kernel. Byte plane i of this
+                            # block lives at lanes [i*S + off, +blk).
+                            def plane(i):
+                                return bytes_ref[
+                                    pl.dslice(d, 1),
+                                    pl.dslice(i * S + off, blk),
+                                ]
+
+                            if kind == POLY:
+                                ids = jnp.zeros((1, blk), jnp.int32)
+                                for i in range(n):
+                                    ids = ids * 256 + plane(i)
+                                ids = ids + p1
+                            else:
+                                # FNV-1a, wrapping int32 == uint32 bits.
+                                h = jnp.full(
+                                    (1, blk), _FNV_OFFSET_I32, jnp.int32
+                                )
+                                for i in range(n):
+                                    h = (h ^ plane(i)) * _FNV_PRIME_I32
+                                if kind == FNV_MASK:
+                                    ids = h & p1
+                                else:
+                                    # h mod p2 (p2 not a power of two):
+                                    # float quotient + correction steps.
+                                    # f32(h) carries ≤2^-24 relative error
+                                    # (≤256 absolute at 2^32), so q is off
+                                    # by at most ~1; two bidirectional
+                                    # corrections restore the exact
+                                    # remainder. h - q*p2 wraps in int32
+                                    # but the true value fits, so the low
+                                    # 32 bits are the answer.
+                                    hf = h.astype(jnp.float32)
+                                    hf = jnp.where(
+                                        h < 0, hf + jnp.float32(2.0**32), hf
+                                    )
+                                    q = jnp.floor(
+                                        hf / jnp.float32(p2)
+                                    ).astype(jnp.int32)
+                                    r = h - q * p2
+                                    r = jnp.where(r < 0, r + p2, r)
+                                    r = jnp.where(r < 0, r + p2, r)
+                                    r = jnp.where(r >= p2, r - p2, r)
+                                    r = jnp.where(r >= p2, r - p2, r)
+                                    ids = p1 + r
+                            starts = jax.lax.broadcasted_iota(
+                                jnp.int32, (1, blk), 1
+                            ) + off
+                            mask = (starts <= dlen - n) & (starts < dlim)
+                            if k == 0:
+                                # Scala ``sliding`` partial window: a doc
+                                # shorter than n contributes its whole-byte
+                                # prefix once, spliced into window 0.
+                                short = dlen < n
+                                lane0 = starts == 0
+                                ids = jnp.where(
+                                    lane0 & short, prow_ref[base + d, j], ids
+                                )
+                                mask = mask | (lane0 & short & (dlen > 0))
+                            accumulate(ids, mask)
+
+                        # No window of this block starts inside the doc's
+                        # owned range: skip the hash + matmul entirely.
+                        pl.when((off < dlen) & (off < dlim))(step)
+
+            if has_rows:
+                for k in range(n_rsteps):
+                    off = k * blk
+                    local = off % wseg  # segment-local start (static)
+
+                    def step(off=off):
+                        r = rows_ref[pl.dslice(d, 1), pl.dslice(off, blk)]
+                        # Masked windows arrive as row -1: hi -1 one-hots
+                        # to nothing, so no extra mask plane is needed.
+                        accumulate(r, jnp.full((1, blk), True))
+
+                    pl.when((local < dlen) & (local < dlim))(step)
+
+            # Contract this doc's tile histogram with the resident table
+            # tile: HT small matmuls [1, 256] @ [256, Lpad], f32 over
+            # exact integer counts × integer (quantized) weights.
+            def h_body(h, carry):
+                hrow = hist_ref[pl.dslice(h, 1), :]
+                wrow = wq_ref[
+                    pl.dslice(pl.multiple_of(h * 256, 256), 256), :
+                ].astype(jnp.float32)
+                acc_ref[pl.dslice(d, 1), :] += jax.lax.dot_general(
+                    hrow, wrow, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                return carry
+
+            jax.lax.fori_loop(0, HT, h_body, 0)
+
+        @pl.when(t == T - 1)
+        def _emit():
+            scaled = acc_ref[:, :] * scale_ref[0:1, :]
+            out_ref[:, :] = scaled
+            if want_labels:
+                lane = jax.lax.broadcasted_iota(jnp.int32, (DB, Lpad), 1)
+                masked = jnp.where(lane < n_langs, scaled, -jnp.inf)
+                best = jnp.max(masked, axis=1, keepdims=True)
+                # First maximum wins (reference tie/zero behavior); an
+                # all-miss doc is all-zero scores -> label 0.
+                label_ref[:, :] = jnp.min(
+                    jnp.where(masked == best, lane, Lpad),
+                    axis=1, keepdims=True,
+                )
+                best_ref[:, :] = best
+
+    return kernel
+
+
+# ------------------------------------------------------------- wrapper -----
+
+
+def _window0_ids(batch: jnp.ndarray, n: int, spec: VocabSpec) -> jnp.ndarray:
+    """Exact-mode id of window 0 only (the partial-window helper's seed) —
+    O(B) instead of materializing every window id in XLA."""
+    B, S = batch.shape
+    if S < n:
+        batch = jnp.pad(batch, ((0, 0), (0, n - S)))
+    ids = jnp.zeros((B,), jnp.int32)
+    for i in range(n):
+        ids = ids * 256 + batch[:, i].astype(jnp.int32)
+    return ids + spec.offsets[n]
+
+
+def _inline_partial_rows(
+    batch: jnp.ndarray, lengths: jnp.ndarray, spec: VocabSpec,
+    layout: FusedLayout,
+) -> jnp.ndarray:
+    """int32 [B, max(1, n_inline)] partial-window rows per inline length
+    (meaningful only where 0 < len < n; the kernel masks the rest)."""
+    cols = []
+    for n, _, _, _ in layout.inline:
+        if spec.mode == EXACT:
+            w0 = _window0_ids(batch, n, spec)
+        else:
+            w0 = jnp.zeros((batch.shape[0],), jnp.int32)  # hashed: unused
+        cols.append(partial_window_ids(batch, lengths, n, w0, spec))
+    if not cols:
+        cols = [jnp.zeros((batch.shape[0],), jnp.int32)]
+    return jnp.stack(cols, axis=1).astype(jnp.int32)
+
+
+def _rows_plane(
+    batch: jnp.ndarray,
+    lengths: jnp.ndarray,
+    lut: jnp.ndarray | None,
+    window_limit: jnp.ndarray | None,
+    spec: VocabSpec,
+    layout: FusedLayout,
+    wseg: int,
+) -> jnp.ndarray:
+    """int32 [B, K*wseg] concatenated fused-row segments for the lengths
+    whose membership lives in XLA (masked/padded windows are -1: the
+    kernel's hi one-hot matches nothing there)."""
+    B = batch.shape[0]
+    segs = []
+    for n in layout.rows_lengths:
+        ids = window_ids(batch, n, spec)
+        rows = ids if lut is None else lut[ids]
+        pids = partial_window_ids(batch, lengths, n, ids[:, 0], spec)
+        prow = pids if lut is None else lut[pids]
+        prow = jnp.where(lengths > 0, prow, -1)
+        rows, mask = _splice_partial_windows(
+            rows, prow, lengths, n, window_limit
+        )
+        rows = jnp.where(mask, rows, -1)
+        pad = wseg - rows.shape[1]
+        if pad:
+            rows = jnp.pad(rows, ((0, 0), (0, pad)), constant_values=-1)
+        segs.append(rows)
+    return (
+        jnp.concatenate(segs, axis=1) if len(segs) > 1 else segs[0]
+    ).astype(jnp.int32)
+
+
+def _fused_call(
+    batch: jnp.ndarray,
+    lengths: jnp.ndarray,
+    wq: jnp.ndarray,
+    scales: jnp.ndarray,
+    lut: jnp.ndarray | None,
+    window_limit: jnp.ndarray | None,
+    spec: VocabSpec,
+    layout: FusedLayout,
+    block: int,
+    interpret: bool,
+    want_labels: bool,
+):
+    B0, S0 = batch.shape
+    if layout.rows and wq.shape != (layout.rows_padded, layout.lpad):
+        raise ValueError(
+            f"fused table shape {wq.shape} disagrees with layout "
+            f"({layout.rows_padded}, {layout.lpad})"
+        )
+    # Lane padding: S a multiple of the window block.
+    blk = min(block, -(-S0 // 128) * 128)
+    S = -(-S0 // blk) * blk
+    if S != S0:
+        batch = jnp.pad(batch, ((0, 0), (0, S - S0)))
+    # Sublane padding: whole DB-document grid steps (pad rows: length 0).
+    B = -(-B0 // DB) * DB
+    if B != B0:
+        batch = jnp.pad(batch, ((0, B - B0), (0, 0)))
+        lengths = jnp.pad(lengths, (0, B - B0))
+        if window_limit is not None:
+            window_limit = jnp.pad(window_limit, (0, B - B0))
+    lengths = lengths.astype(jnp.int32)
+    lim = (
+        jnp.full((B,), S, dtype=jnp.int32)
+        if window_limit is None
+        else window_limit.astype(jnp.int32)
+    )
+    b32 = batch.astype(jnp.int32)
+
+    has_inline = bool(layout.inline)
+    has_rows = bool(layout.rows_lengths)
+
+    operands = []
+    in_specs = []
+    if has_inline:
+        # Pre-shifted byte planes on the lane axis (Mosaic needs
+        # 128-aligned lane slices — same workaround as score_pallas's b1).
+        P = layout.max_inline
+        planes = [
+            jnp.pad(b32[:, i:], ((0, 0), (0, i))) if i else b32
+            for i in range(P)
+        ]
+        operands.append(jnp.concatenate(planes, axis=1))
+        in_specs.append(
+            pl.BlockSpec(
+                (DB, P * S), lambda b, t: (b, 0), memory_space=pltpu.VMEM
+            )
+        )
+    wseg = 0
+    if has_rows:
+        wmax = max(
+            max(S - n + 1, 1) for n in layout.rows_lengths
+        )
+        wseg = -(-wmax // blk) * blk
+        operands.append(
+            _rows_plane(batch, lengths, lut, window_limit, spec, layout, wseg)
+        )
+        KW = wseg * len(layout.rows_lengths)
+        in_specs.append(
+            pl.BlockSpec(
+                (DB, KW), lambda b, t: (b, 0), memory_space=pltpu.VMEM
+            )
+        )
+    operands += [lengths, lim]
+    in_specs += [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+    ]
+    if has_inline:
+        operands.append(_inline_partial_rows(batch, lengths, spec, layout))
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    HT, T, Lpad = layout.tile_hi, layout.tiles, layout.lpad
+    operands.append(wq)
+    in_specs.append(
+        pl.BlockSpec(
+            (HT * 256, Lpad), lambda b, t: (t, 0), memory_space=pltpu.VMEM
+        )
+    )
+    operands.append(scales.astype(jnp.float32))
+    in_specs.append(
+        pl.BlockSpec((8, Lpad), lambda b, t: (0, 0), memory_space=pltpu.VMEM)
+    )
+
+    out_shape = [jax.ShapeDtypeStruct((B, Lpad), jnp.float32)]
+    out_specs = [
+        pl.BlockSpec((DB, Lpad), lambda b, t: (b, 0), memory_space=pltpu.VMEM)
+    ]
+    if want_labels:
+        out_shape += [
+            jax.ShapeDtypeStruct((B, 1), jnp.int32),
+            jax.ShapeDtypeStruct((B, 1), jnp.float32),
+        ]
+        out_specs += [
+            pl.BlockSpec(
+                (DB, 1), lambda b, t: (b, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (DB, 1), lambda b, t: (b, 0), memory_space=pltpu.VMEM
+            ),
+        ]
+
+    out = pl.pallas_call(
+        _build_fused_kernel(
+            S if has_inline else 0,
+            wseg * len(layout.rows_lengths),
+            wseg,
+            blk,
+            layout,
+            want_labels,
+        ),
+        grid=(B // DB, T),
+        in_specs=in_specs,
+        out_specs=out_specs if want_labels else out_specs[0],
+        out_shape=out_shape if want_labels else out_shape[0],
+        scratch_shapes=[
+            pltpu.VMEM((HT, 256), jnp.float32),
+            pltpu.VMEM((DB, Lpad), jnp.float32),
+        ],
+        compiler_params=COMPILER_PARAMS(
+            dimension_semantics=("arbitrary", "arbitrary")
+        ),
+        interpret=interpret,
+    )(*operands)
+    if want_labels:
+        scores, labels, best = out
+        return (
+            scores[:B0, : layout.n_langs],
+            labels[:B0, 0],
+            best[:B0, 0],
+        )
+    return out[:B0, : layout.n_langs]
+
+
+@partial(
+    jax.jit,
+    static_argnames=("spec", "layout", "block", "interpret"),
+)
+def score_batch_fused(
+    batch: jnp.ndarray,
+    lengths: jnp.ndarray,
+    wq: jnp.ndarray,
+    scales: jnp.ndarray,
+    lut: jnp.ndarray | None = None,
+    window_limit: jnp.ndarray | None = None,
+    *,
+    spec: VocabSpec,
+    layout: FusedLayout,
+    block: int = DEFAULT_BLOCK,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """float32 [B, L] scores via the fused megakernel.
+
+    Same contract as :func:`ops.score.score_batch` (masking, Scala
+    ``sliding`` partial-window rule, ``window_limit`` chunk ownership) with
+    the table pre-built by :func:`build_fused_tables`. Scores carry the
+    per-language dequantize scale, so chunked long documents sum across
+    dispatches exactly like every other strategy.
+    """
+    return _fused_call(
+        batch, lengths, wq, scales, lut, window_limit,
+        spec, layout, block, interpret, want_labels=False,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("spec", "layout", "block", "interpret"),
+)
+def detect_batch_fused(
+    batch: jnp.ndarray,
+    lengths: jnp.ndarray,
+    wq: jnp.ndarray,
+    scales: jnp.ndarray,
+    lut: jnp.ndarray | None = None,
+    window_limit: jnp.ndarray | None = None,
+    *,
+    spec: VocabSpec,
+    layout: FusedLayout,
+    block: int = DEFAULT_BLOCK,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(labels int32 [B], best float32 [B]) — argmax in-kernel.
+
+    The serving-path variant of :func:`score_batch_fused`: per document
+    only the label/score pair leaves the chip. First-maximum ties, all-miss
+    docs label 0 (the scores themselves never reach HBM).
+    """
+    _, labels, best = _fused_call(
+        batch, lengths, wq, scales, lut, window_limit,
+        spec, layout, block, interpret, want_labels=True,
+    )
+    return labels, best

@@ -44,6 +44,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .score import _splice_partial_windows
+from .score_pallas import COMPILER_PARAMS
 from .vocab import (
     VocabSpec,
     mix32,
@@ -205,7 +206,7 @@ def _hist_from_rows(
         ),
         out_shape=jax.ShapeDtypeStruct((B * Rhi, 256), jnp.float32),
         scratch_shapes=[pltpu.VMEM((Rhi, 256), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=COMPILER_PARAMS(
             dimension_semantics=("arbitrary",)
         ),
         interpret=interpret,

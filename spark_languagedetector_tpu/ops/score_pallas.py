@@ -48,6 +48,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .vocab import EXACT, VocabSpec
 
+# jax renamed TPUCompilerParams -> CompilerParams between 0.4.x and 0.5;
+# alias once so the kernels lower (and interpret) on both.
+COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
 # Documents per grid step: the sublane tile height of the batch block.
 DB = 8
 
@@ -235,7 +241,7 @@ def _hist_batch(
         ),
         out_shape=jax.ShapeDtypeStruct((B * 256, 256), jnp.float32),
         scratch_shapes=[pltpu.VMEM((256, 256), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=COMPILER_PARAMS(
             dimension_semantics=("arbitrary",)
         ),
         interpret=interpret,
@@ -376,7 +382,7 @@ def score_batch_pallas(
             pltpu.VMEM((256, 256), jnp.float32),
             pltpu.VMEM((256, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=COMPILER_PARAMS(
             dimension_semantics=("arbitrary",)
         ),
         interpret=interpret,

@@ -28,6 +28,28 @@ DATA_AXIS = "data"
 VOCAB_AXIS = "vocab"
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` across the jax versions this repo runs on.
+
+    jax >= 0.5 exposes ``jax.shard_map(..., check_vma=)``; 0.4.x only has
+    ``jax.experimental.shard_map.shard_map(..., check_rep=)`` (same knob,
+    earlier name). One alias site so every mesh wrapper (runner pallas/hist
+    dispatch, the ring scorer) stays version-agnostic.
+    """
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as sm
+
+    return sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
 def build_mesh(
     data: int | None = None,
     vocab: int = 1,

@@ -31,7 +31,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.encoding import chunk_document
 from ..ops.score import score_batch
 from ..ops.vocab import VocabSpec
-from .mesh import DATA_AXIS, batch_sharding, pad_to_multiple, replicated
+from .mesh import (
+    DATA_AXIS,
+    batch_sharding,
+    pad_to_multiple,
+    replicated,
+    shard_map_compat,
+)
 
 
 def chunk_grid(
@@ -141,7 +147,7 @@ def ring_score_chunks(
         return acc[None, :]
 
     ids_arr = lut if lut is not None else jnp.zeros(0, jnp.int32)
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         shard_fn,
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(), P()),

@@ -76,6 +76,7 @@ def save_model(
     params: dict,
     overwrite: bool = True,
     layout: str = "native",
+    quantize: str | None = None,
 ) -> None:
     """Write the model directory (SaveMode.Overwrite semantics).
 
@@ -84,6 +85,14 @@ def save_model(
     paramMap limited to the params the reference model declares
     (HasInputCol/HasOutputCol) — so the Spark reader can load it. Exact
     vocabs only: the reference has no hashed mode to round-trip into.
+
+    ``quantize`` ('int8' | 'int16') stores the weight matrix quantized:
+    integer parquet columns plus per-language f32 scales in the metadata
+    (``models.profile.quantize_weights``). A lossy codec — the loader
+    reconstructs ``q * scale`` f32 weights — but a fixed point of
+    quantize∘dequantize, so a model served through the fused quantized
+    strategy round-trips to bit-identical quantized scores, at 4x/2x less
+    disk than float64 rows. Native layout only.
     """
     import pyarrow as pa
 
@@ -94,6 +103,19 @@ def save_model(
             "layout='reference' requires an exact vocab — the reference "
             "implementation stores gram bytes and has no hashed mode"
         )
+    if quantize is not None:
+        from ..models.profile import QUANT_DTYPES
+
+        if quantize not in QUANT_DTYPES:
+            raise ValueError(
+                f"unknown quantize dtype {quantize!r}; expected one of "
+                f"{tuple(QUANT_DTYPES)}"
+            )
+        if layout == "reference":
+            raise ValueError(
+                "quantize is a native-layout extension — the reference "
+                "format stores float64 rows only"
+            )
     root = Path(path)
     if root.exists():
         if not overwrite:
@@ -134,6 +156,23 @@ def save_model(
             },
             "languages": list(profile.languages),
         }
+    # Quantized storage: the integer rows go into probabilities/, the
+    # per-language scales (the other half of the codec) into metadata.
+    # One compaction pass serves both the quantizer and the bucket/gram
+    # columns below (a no-op for already-compact profiles; for the dense
+    # hashed form it is a full-table scan worth doing once).
+    compact = profile.compacted()
+    quant_rows = None
+    if quantize is not None:
+        from ..models.profile import quantize_weights
+
+        quant_rows, quant_scales = quantize_weights(
+            compact.weights, quantize
+        )
+        meta["quantization"] = {
+            "dtype": quantize,
+            "scales": [float(s) for s in quant_scales],
+        }
     meta_dir = root / "metadata"
     meta_dir.mkdir()
     (meta_dir / "part-00000").write_text(json.dumps(meta) + "\n")
@@ -160,23 +199,28 @@ def save_model(
         )
     elif profile.spec.mode == EXACT:
         grams = [profile.spec.id_to_gram(int(i)) for i in profile.ids]
+        rows = (
+            quant_rows if quant_rows is not None else profile.weights
+        )
+        value_type = pa.int32() if quant_rows is not None else pa.float64()
         prob_table = pa.table(
             {
                 "gram": pa.array(grams, type=pa.binary()),
                 "probabilities": pa.array(
-                    [row.tolist() for row in profile.weights],
-                    type=pa.list_(pa.float64()),
+                    [row.tolist() for row in rows],
+                    type=pa.list_(value_type),
                 ),
             }
         )
     else:
-        compact = profile.compacted()
+        rows = quant_rows if quant_rows is not None else compact.weights
+        value_type = pa.int32() if quant_rows is not None else pa.float64()
         prob_table = pa.table(
             {
                 "bucket": pa.array(compact.ids.tolist(), type=pa.int64()),
                 "probabilities": pa.array(
-                    [row.tolist() for row in compact.weights],
-                    type=pa.list_(pa.float64()),
+                    [row.tolist() for row in rows],
+                    type=pa.list_(value_type),
                 ),
             }
         )
@@ -269,6 +313,17 @@ def load_model(path: str | Path) -> tuple[GramProfile, str, dict]:
             np.stack([p[1] for p in pairs])
             if pairs
             else np.zeros((0, L), dtype=np.float64)
+        )
+
+    quant_meta = meta.get("quantization")
+    if quant_meta and len(weights):
+        # Quantized storage codec: rows are exact integers (read back as
+        # float64), scales per language. The float64 product q*scale is
+        # exact, so the f32 device cast matches models.profile.
+        # dequantize_weights bit-for-bit — and requantizing returns the
+        # stored integers, making fused quantized scores save/load-stable.
+        weights = weights * np.asarray(
+            quant_meta["scales"], dtype=np.float64
         )
 
     profile = GramProfile(spec=spec, languages=languages, ids=ids, weights=weights)

@@ -55,12 +55,18 @@ class ModelVersion:
         self.retired = False
 
     def describe(self) -> dict:
+        try:
+            quant = self.model.get_or_default("quantization")
+        except Exception:
+            quant = None
         return {
             "version": self.version,
             "uid": self.model.uid,
             "languages": len(self.languages),
             "grams": int(self.model.profile.num_grams),
             "source": self.source,
+            "strategy": self.runner.strategy,
+            "quantization": quant,
             "installed_at": self.installed_at,
             "inflight": self.inflight,
             "retired": self.retired,

@@ -40,6 +40,76 @@ DEFAULT_METRICS = ("p50", "p90", "p99", "device_total_s", "device_p99")
 # seconds, latency, padding waste, retries) regresses upward.
 _HIGHER_BETTER = ("fill_ratio",)
 
+# Tracked gauges (last snapshot): table-traffic contract metrics. A change
+# that silently de-quantizes a profile (table_bytes jumps 4x) or
+# re-balloons a program's memory traffic (est_bytes_utilization climbs
+# back toward the HBM roof) regresses here even when every latency
+# percentile held steady — docs/PERFORMANCE.md §7.
+_TRACKED_GAUGES = ("langdetect_table_bytes",)
+
+
+def _tracked_metrics(events: list[dict], stages: dict) -> dict[str, float]:
+    """Gauge-derived contract metrics from a capture's LAST snapshot.
+
+    ``table_bytes[...]`` is the raw gauge per label set;
+    ``est_bytes_utilization[<program>]`` is re-derived exactly like
+    ``Registry.stage_summary`` joins it: program_bytes_accessed per call /
+    measured per-call seconds (fenced device mean preferred) / the
+    platform peak — so the guard sees the same number the bench telemetry
+    block reports.
+    """
+    gauges: dict = {}
+    for ev in events:
+        if ev.get("event") != "telemetry.snapshot":
+            continue
+        payload = ev.get("gauges")
+        if isinstance(payload, dict):
+            gauges = payload
+    out: dict[str, float] = {}
+    for name in _TRACKED_GAUGES:
+        series = gauges.get(name)
+        if not isinstance(series, dict):
+            continue
+        # Keyed by PROGRAM only, max over label sets: the quant/strategy
+        # labels change when a profile de-quantizes, and a key that moves
+        # with them would downgrade exactly that regression to an
+        # informational one-sided line. Under one program key, an int8 →
+        # f32 flip is a same-key 4x value jump and fails the diff.
+        for label, val in series.items():
+            if not isinstance(val, (int, float)):
+                continue
+            program = dict(
+                p.split("=", 1) for p in label.split(",") if "=" in p
+            ).get("program", label)
+            key = f"table_bytes[{program}]"
+            out[key] = max(out.get(key, 0.0), float(val))
+    peak = None
+    for label, val in (gauges.get("device_peak_bytes_per_s") or {}).items():
+        if isinstance(val, (int, float)) and val > 0:
+            peak = float(val)
+            break
+    if peak:
+        for label, per_call in (
+            gauges.get("program_bytes_accessed") or {}
+        ).items():
+            if not isinstance(per_call, (int, float)):
+                continue
+            program = dict(
+                p.split("=", 1) for p in label.split(",") if "=" in p
+            ).get("program")
+            entry = stages.get(program)
+            if not entry:
+                continue
+            seconds = entry.get("mean")
+            if entry.get("device_total_s") and entry.get("count"):
+                seconds = entry["device_total_s"] / entry["count"]
+            if not seconds:
+                continue
+            out[f"est_bytes_utilization[{program}]"] = round(
+                per_call / seconds / peak, 6
+            )
+    return out
+
 
 def capture_stats(events: list[dict]) -> dict:
     """One capture's comparable stats: per-stage wall/device aggregates +
@@ -105,7 +175,12 @@ def capture_stats(events: list[dict]) -> dict:
                     )
                 )
             }
-    return {"stages": stages, "histograms": hists, "counters": counters}
+    return {
+        "stages": stages,
+        "histograms": hists,
+        "counters": counters,
+        "tracked": _tracked_metrics(events, stages),
+    }
 
 
 def _rel_delta(base: float, new: float) -> float | None:
@@ -206,6 +281,34 @@ def compare_captures(
             lines.append(
                 f"{name:<28} {'count':<14} {bv:>12.6f} "
                 f"{nv:>12.6f} {shown}{flag}"
+            )
+
+    # Tracked table-traffic gauges: upward movement past threshold is a
+    # regression (more table bytes resident / streamed, more of the HBM
+    # roof consumed). Unlike the recovery counters, a metric appearing in
+    # only one capture is informational — instrumentation grows between
+    # rounds, and a freshly-tracked gauge has no contract yet.
+    b_t, n_t = base.get("tracked", {}), new.get("tracked", {})
+    for name in sorted(set(b_t) | set(n_t)):
+        if name not in b_t or name not in n_t:
+            lines.append(
+                f"tracked metric only in "
+                f"{'baseline' if name in b_t else 'candidate'}: {name}"
+            )
+            continue
+        delta = _rel_delta(b_t[name], n_t[name])
+        if delta is None:
+            continue
+        flag = ""
+        if delta > threshold:
+            flag = "  REGRESSION"
+            regressions.append(
+                f"{name}: {b_t[name]:g} -> {n_t[name]:g} (+{delta:.1%})"
+            )
+        if flag or abs(delta) > threshold / 2:
+            lines.append(
+                f"{name:<28} {'gauge':<14} {b_t[name]:>12.6f} "
+                f"{n_t[name]:>12.6f} {delta:>+8.1%}{flag}"
             )
 
     if only_base:

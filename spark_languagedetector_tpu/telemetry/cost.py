@@ -173,11 +173,30 @@ def record_runner_cost(
     unpack + the same scoring math, so flops match but ``bytes_accessed``
     is the padded upper bound (and no variant models the h2d wire —
     cost_analysis is program-side memory traffic, not transfer bytes).
+
+    Alongside the cost gauges this records ``langdetect_table_bytes``
+    (the resident weight-side bytes of the strategy's device form, quant
+    label included) — the compare guard tracks it so a change that
+    silently de-quantizes or re-balloons table traffic fails the diff.
+    The fused strategy's program is additionally recorded under
+    ``program="score/fused"`` so its roofline shift vs the strategy it
+    replaced stays visible when both appear in one capture.
     """
     try:
         import jax
         import jax.numpy as jnp
 
+        reg = registry if registry is not None else REGISTRY
+        try:
+            reg.set_gauge(
+                "langdetect_table_bytes",
+                float(runner.table_bytes()),
+                program="score/dispatch",
+                quant=getattr(runner, "quantization", None) or "f32",
+                strategy=runner.strategy,
+            )
+        except Exception:
+            pass
         if runner.mesh is not None:
             return None
         batch = jax.ShapeDtypeStruct((int(rows), int(pad_to)), jnp.uint8)
@@ -192,6 +211,10 @@ def record_runner_cost(
         record_program_cost(
             "score/dispatch", cost, platform=platform, registry=registry
         )
+        if runner.strategy == "fused":
+            record_program_cost(
+                "score/fused", cost, platform=platform, registry=registry
+            )
         return cost
     except Exception:
         return None

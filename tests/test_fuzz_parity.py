@@ -17,19 +17,23 @@ from spark_languagedetector_tpu.ops.score import score_batch_numpy
 from spark_languagedetector_tpu.ops.vocab import EXACT, HASHED, VocabSpec
 
 CASES = [
-    # (spec, strategies that must handle it)
-    (VocabSpec(EXACT, (2,)), ("gather", "onehot", "pallas")),
-    (VocabSpec(EXACT, (1, 2)), ("gather", "onehot", "pallas")),
-    (VocabSpec(EXACT, (1, 2, 3)), ("gather", "hybrid", "hist")),
+    # (spec, strategies that must handle it). "fused" appears wherever
+    # the megakernel covers the form: dense tables (in-kernel ids/FNV)
+    # and LUT membership — everywhere except packed-key cuckoo profiles
+    # (exact gram lengths 4..5).
+    (VocabSpec(EXACT, (2,)), ("gather", "onehot", "pallas", "fused")),
+    (VocabSpec(EXACT, (1, 2)), ("gather", "onehot", "pallas", "fused")),
+    (VocabSpec(EXACT, (1, 2, 3)), ("gather", "hybrid", "hist", "fused")),
     (VocabSpec(EXACT, (1, 3, 5)), ("gather", "hist")),
     (VocabSpec(EXACT, (4,)), ("gather", "hist")),
     (VocabSpec(EXACT, (1, 2, 3, 4, 5)), ("gather", "hybrid", "hist")),
     # Small hashed vocabs ship the DENSE table (no LUT/cuckoo), so hist
     # does not apply; fnv1a bucket ids are not exact short-gram ids, so
-    # hybrid doesn't either — gather is the one strategy for this shape.
-    (VocabSpec(HASHED, (1, 2, 3), hash_bits=11), ("gather",)),
+    # hybrid doesn't either — gather (and fused, whose FNV runs
+    # in-kernel over the dense bucket table) cover this shape.
+    (VocabSpec(HASHED, (1, 2, 3), hash_bits=11), ("gather", "fused")),
     (VocabSpec(HASHED, (1, 2, 3, 4, 5), hash_bits=17, hash_scheme="exact12"),
-     ("gather", "hybrid")),
+     ("gather", "hybrid", "fused")),
 ]
 
 

@@ -1,0 +1,510 @@
+"""Fused detect megakernel (ops.score_fused) parity + quantized profiles.
+
+The fused strategy must match the gather scorers in argmax everywhere and in
+scores up to f32 reduction order (unquantized) or the documented quantized
+tolerance class (int8/int16 tables, per-language f32 scales) — across dense
+in-kernel-hash layouts, LUT membership, the exact12 short-gram split, window
+limits, chunked long docs, and the degraded-mode ladder. Runs in Pallas
+interpret mode on the CPU substrate (tests/conftest.py); the Mosaic lowering
+is exercised by the opt-in real-TPU suite (test_tpu_hw).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_languagedetector_tpu.api.runner import BatchRunner
+from spark_languagedetector_tpu.models.profile import (
+    GramProfile,
+    dequantize_weights,
+    quantize_weights,
+)
+from spark_languagedetector_tpu.ops import score as S
+from spark_languagedetector_tpu.ops import score_fused as SF
+from spark_languagedetector_tpu.ops.encoding import pad_batch
+from spark_languagedetector_tpu.ops.vocab import (
+    EXACT,
+    HASHED,
+    VocabSpec,
+)
+from spark_languagedetector_tpu.resilience.faults import FaultPlan
+from spark_languagedetector_tpu.resilience import faults
+from spark_languagedetector_tpu.resilience.policy import (
+    CircuitBreaker,
+    RetryPolicy,
+)
+from spark_languagedetector_tpu.telemetry import REGISTRY
+
+RNG = np.random.default_rng(11)
+L = 6
+
+
+def _random_docs(n, lo=97, hi=112, max_len=60):
+    docs = [
+        bytes(RNG.integers(lo, hi, RNG.integers(0, max_len)).tolist())
+        for _ in range(n)
+    ]
+    docs += [b"", b"a", b"ab", bytes(RNG.integers(0, 256, 200).tolist())]
+    return docs
+
+
+def _batch(docs, pad_to=256):
+    b, l = pad_batch(docs, pad_to)
+    return jnp.asarray(b), jnp.asarray(l)
+
+
+def _dense_exact_bigram(n_learned=500):
+    spec = VocabSpec(EXACT, (2,))
+    w = np.zeros((spec.id_space_size, L), np.float32)
+    learned = RNG.choice(spec.id_space_size, n_learned, replace=False)
+    w[learned] = RNG.normal(size=(n_learned, L)).astype(np.float32)
+    return spec, w
+
+
+def _lut_fixture(spec, n_rows=200):
+    V = spec.id_space_size
+    lut = np.full(V, n_rows, np.int32)
+    learned = RNG.choice(V, n_rows, replace=False)
+    lut[learned] = np.arange(n_rows)
+    w = np.zeros((n_rows + 1, L), np.float32)
+    w[:-1] = RNG.normal(size=(n_rows, L)).astype(np.float32)
+    return w, lut
+
+
+def _dense_from_lut(spec, w, lut):
+    miss = w.shape[0] - 1
+    wd = np.zeros((spec.id_space_size, L), np.float32)
+    ids = np.nonzero(lut != miss)[0]
+    wd[ids] = w[lut[ids]]
+    return wd
+
+
+def _fused_scores(w, lut, spec, docs, quant=None, limit=None, pad_to=256):
+    b, l = _batch(docs, pad_to)
+    ft = SF.build_fused_tables(w, lut, spec, quant)
+    return np.asarray(
+        SF.score_batch_fused(
+            b, l, jnp.asarray(ft.wq), jnp.asarray(ft.scales),
+            None if ft.lut is None else jnp.asarray(ft.lut), limit,
+            spec=spec, layout=ft.layout, block=128, interpret=True,
+        )
+    )
+
+
+# ------------------------------------------------------ kernel parity -------
+def test_fused_matches_gather_exact_dense():
+    """Config-1 territory: exact bigram dense table, ids fully in-kernel."""
+    spec, w = _dense_exact_bigram()
+    docs = _random_docs(13)
+    b, l = _batch(docs)
+    ref = np.asarray(S.score_batch(b, l, jnp.asarray(w), None, spec=spec))
+    got = _fused_scores(w, None, spec, docs)
+    np.testing.assert_allclose(got, ref, atol=1e-3)
+    assert (np.argmax(got, 1) == np.argmax(ref, 1)).all()
+
+
+def test_fused_detect_variant_matches_scores_argmax():
+    spec, w = _dense_exact_bigram()
+    docs = _random_docs(11)
+    b, l = _batch(docs)
+    ft = SF.build_fused_tables(w, None, spec, None)
+    scores = SF.score_batch_fused(
+        b, l, jnp.asarray(ft.wq), jnp.asarray(ft.scales), None, None,
+        spec=spec, layout=ft.layout, block=128, interpret=True,
+    )
+    labels, best = SF.detect_batch_fused(
+        b, l, jnp.asarray(ft.wq), jnp.asarray(ft.scales), None, None,
+        spec=spec, layout=ft.layout, block=128, interpret=True,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(labels), np.argmax(np.asarray(scores), axis=1)
+    )
+    np.testing.assert_allclose(
+        np.asarray(best), np.max(np.asarray(scores), axis=1), atol=1e-5
+    )
+
+
+def test_fused_matches_gather_hashed_lut_fnv1a():
+    """fnv1a-scheme hashed vocab: every length through XLA membership."""
+    spec = VocabSpec(HASHED, (1, 2, 3), hash_bits=12)
+    assert spec.hash_scheme == "fnv1a"
+    w, lut = _lut_fixture(spec)
+    docs = _random_docs(13)
+    b, l = _batch(docs)
+    ref = np.asarray(
+        S.score_batch(b, l, jnp.asarray(w), jnp.asarray(lut), spec=spec)
+    )
+    got = _fused_scores(w, lut, spec, docs)
+    np.testing.assert_allclose(got, ref, atol=1e-3)
+
+
+def test_fused_matches_gather_hashed_dense_inkernel_fnv():
+    """Dense fnv1a table: the FNV hash + power-of-two mask run in-kernel."""
+    spec = VocabSpec(HASHED, (1, 2, 3), hash_bits=12)
+    w, lut = _lut_fixture(spec)
+    wd = _dense_from_lut(spec, w, lut)
+    docs = _random_docs(13)
+    b, l = _batch(docs)
+    ref = np.asarray(S.score_batch(b, l, jnp.asarray(wd), None, spec=spec))
+    ft = SF.build_fused_tables(wd, None, spec, None)
+    assert ft.layout.rows_lengths == ()  # everything inline
+    got = _fused_scores(wd, None, spec, docs)
+    np.testing.assert_allclose(got, ref, atol=1e-3)
+
+
+def test_fused_matches_gather_hashed_exact12_split():
+    """exact12 LUT profile (the production 2^20 form at test scale): short
+    grams score through the dense12 region with in-kernel polynomial ids,
+    long grams through the re-based LUT rows plane."""
+    spec = VocabSpec(HASHED, (1, 2, 3, 4, 5), hash_bits=17)
+    assert spec.hash_scheme == "exact12"
+    w, lut = _lut_fixture(spec, 300)
+    docs = _random_docs(13)
+    b, l = _batch(docs)
+    ref = np.asarray(
+        S.score_batch(b, l, jnp.asarray(w), jnp.asarray(lut), spec=spec)
+    )
+    ft = SF.build_fused_tables(w, lut, spec, None)
+    assert [n for n, _, _, _ in ft.layout.inline] == [1, 2]
+    assert ft.layout.rows_lengths == (3, 4, 5)
+    got = _fused_scores(w, lut, spec, docs)
+    np.testing.assert_allclose(got, ref, atol=1e-3)
+
+
+def test_fused_matches_gather_hashed_exact12_dense_fold():
+    """Dense exact12 table: the non-power-of-two fold modulus reduces
+    in-kernel via the float-quotient trick — must match the host fold
+    bit-for-bit (any mismatch re-buckets a window)."""
+    spec = VocabSpec(HASHED, (1, 2, 3, 4, 5), hash_bits=17)
+    w, lut = _lut_fixture(spec, 300)
+    wd = _dense_from_lut(spec, w, lut)
+    docs = _random_docs(13)
+    b, l = _batch(docs)
+    ref = np.asarray(S.score_batch(b, l, jnp.asarray(wd), None, spec=spec))
+    got = _fused_scores(wd, None, spec, docs)
+    np.testing.assert_allclose(got, ref, atol=1e-3)
+
+
+def test_fused_respects_window_limit():
+    spec = VocabSpec(HASHED, (1, 2, 3), hash_bits=12)
+    w, lut = _lut_fixture(spec)
+    docs = _random_docs(9)
+    b, l = _batch(docs)
+    limit = jnp.asarray(RNG.integers(1, 40, len(docs)).astype(np.int32))
+    ref = np.asarray(
+        S.score_batch(
+            b, l, jnp.asarray(w), jnp.asarray(lut), spec=spec,
+            window_limit=limit,
+        )
+    )
+    got = _fused_scores(w, lut, spec, docs, limit=limit)
+    np.testing.assert_allclose(got, ref, atol=1e-3)
+
+
+def test_fused_empty_and_all_miss_docs_argmax_zero():
+    """Reference Q6 semantics: empty docs and docs hitting no learned gram
+    score all-zeros and argmax to index 0."""
+    spec, w = _dense_exact_bigram(n_learned=0)  # nothing learned
+    docs = [b"", b"anything", bytes(range(200, 240))]
+    b, l = _batch(docs)
+    ft = SF.build_fused_tables(w, None, spec, "int8")
+    labels, best = SF.detect_batch_fused(
+        b, l, jnp.asarray(ft.wq), jnp.asarray(ft.scales), None, None,
+        spec=spec, layout=ft.layout, block=128, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(labels), 0)
+    np.testing.assert_array_equal(np.asarray(best), 0.0)
+
+
+# ------------------------------------------------------ quantization --------
+def test_quantize_weights_round_trip_fixed_point():
+    w = RNG.normal(size=(300, L)).astype(np.float32) * 7.5
+    for dtype, itemsize in (("int8", 1), ("int16", 2)):
+        q, scales = quantize_weights(w, dtype)
+        assert q.dtype == np.dtype(dtype) and scales.dtype == np.float32
+        deq = dequantize_weights(q, scales)
+        q2, scales2 = quantize_weights(deq, dtype)
+        np.testing.assert_array_equal(q, q2)  # fixed point
+        np.testing.assert_array_equal(scales, scales2)
+        assert q.nbytes == w.shape[0] * L * itemsize
+
+
+def test_quantize_weights_zero_column_and_bad_dtype():
+    w = np.zeros((10, 3), np.float32)
+    q, scales = quantize_weights(w, "int8")
+    np.testing.assert_array_equal(scales, 1.0)
+    np.testing.assert_array_equal(dequantize_weights(q, scales), 0.0)
+    with pytest.raises(ValueError, match="unknown quantization"):
+        quantize_weights(w, "int4")
+
+
+@pytest.mark.parametrize("quant", ["int8", "int16"])
+def test_fused_quantized_agreement_and_table_bytes(quant):
+    spec = VocabSpec(HASHED, (1, 2, 3, 4, 5), hash_bits=17)
+    w, lut = _lut_fixture(spec, 300)
+    docs = _random_docs(13)
+    b, l = _batch(docs)
+    ref = np.asarray(
+        S.score_batch(b, l, jnp.asarray(w), jnp.asarray(lut), spec=spec)
+    )
+    ft = SF.build_fused_tables(w, lut, spec, quant)
+    ratio = {"int8": 0.25, "int16": 0.5}[quant]
+    assert ft.table_bytes == int(ft.f32_bytes * ratio)
+    got = _fused_scores(w, lut, spec, docs, quant=quant)
+    agree = (np.argmax(got, 1) == np.argmax(ref, 1)).mean()
+    assert agree == 1.0  # test fixture is small; errors are ~1e-2 relative
+
+
+# ------------------------------------------------------ runner integration --
+def test_runner_fused_strategy_matches_gather_with_chunking():
+    """End-to-end through BatchRunner incl. an oversized doc split into
+    chunks whose scaled scores must sum exactly across dispatches."""
+    spec, w = _dense_exact_bigram()
+    docs = _random_docs(11) + [bytes(b"abcde" * 300)]  # forces chunking
+    ref = BatchRunner(
+        weights=jnp.asarray(w), lut=None, spec=spec,
+        strategy="gather", length_buckets=(128, 256),
+    ).score(docs)
+    r = BatchRunner(
+        weights=jnp.asarray(w), lut=None, spec=spec,
+        strategy="fused", length_buckets=(128, 256),
+    )
+    got = r.score(docs)
+    np.testing.assert_allclose(got, ref, atol=1e-3)
+    assert r.table_bytes() == spec.id_space_size * L * 4
+
+
+def test_runner_fused_quantized_chunked_labels_match_f32():
+    spec, w = _dense_exact_bigram()
+    docs = _random_docs(9) + [bytes(b"lmnop" * 300)]
+    kw = dict(
+        weights=jnp.asarray(w), lut=None, spec=spec, strategy="fused",
+        length_buckets=(128, 256),
+    )
+    f32_ids = BatchRunner(**kw).predict_ids(docs)
+    q_ids = BatchRunner(**kw, quantization="int8").predict_ids(docs)
+    np.testing.assert_array_equal(q_ids, f32_ids)
+
+
+def test_runner_fused_hashed_lut_profile():
+    spec = VocabSpec(HASHED, (1, 2, 3, 4, 5), hash_bits=17)
+    w, lut = _lut_fixture(spec, 300)
+    docs = _random_docs(11)
+    kw = dict(
+        weights=jnp.asarray(w), lut=jnp.asarray(lut), spec=spec,
+        length_buckets=(128, 256),
+    )
+    ref = BatchRunner(**kw, strategy="gather").score(docs)
+    got = BatchRunner(**kw, strategy="fused").score(docs)
+    np.testing.assert_allclose(got, ref, atol=1e-3)
+
+
+def test_runner_quantization_forces_fused_under_auto():
+    spec, w = _dense_exact_bigram()
+    r = BatchRunner(
+        weights=jnp.asarray(w), lut=None, spec=spec, quantization="int16",
+    )
+    assert r.strategy == "fused"
+    assert "quantization" in r.strategy_reason
+
+
+def test_runner_quantization_rejects_other_strategies():
+    spec, w = _dense_exact_bigram()
+    with pytest.raises(ValueError, match="fused strategy only"):
+        BatchRunner(
+            weights=jnp.asarray(w), lut=None, spec=spec,
+            strategy="gather", quantization="int8",
+        )
+
+
+def test_runner_fused_rejects_cuckoo_membership():
+    from spark_languagedetector_tpu.ops.cuckoo import build_cuckoo
+    from spark_languagedetector_tpu.ops.vocab import gram_key
+
+    spec = VocabSpec(EXACT, (1, 2, 3, 4, 5))
+    grams = sorted(
+        {bytes(RNG.integers(97, 110, 4).tolist()) for _ in range(100)}
+    )
+    w = np.zeros((len(grams) + 1, L), np.float32)
+    keys = [gram_key(g) for g in grams]
+    table = build_cuckoo(
+        np.asarray([k[0] for k in keys], np.int32),
+        np.asarray([k[1] for k in keys], np.int32),
+    )
+    with pytest.raises(ValueError, match="fused"):
+        BatchRunner(
+            weights=jnp.asarray(w), lut=None, spec=spec, cuckoo=table,
+            strategy="fused",
+        )
+
+
+def test_auto_select_reasons_per_platform():
+    """The auto branch logs WHY a deployment landed on a strategy; the
+    decision table is pinned here platform-by-platform."""
+    spec, w = _dense_exact_bigram()
+    r = BatchRunner(weights=jnp.asarray(w), lut=None, spec=spec)
+    # CPU substrate: XLA one-hot, never interpret-mode pallas.
+    assert r.strategy == "onehot" and "one-hot" in r.strategy_reason
+    # Simulated TPU: fused preferred wherever it covers the form.
+    strat, reason = BatchRunner._auto_select(r, "tpu", True, True, True)
+    assert strat == "fused" and "fused" in reason
+    strat, reason = BatchRunner._auto_select(r, "tpu", False, True, False)
+    assert strat == "pallas"
+    strat, reason = BatchRunner._auto_select(r, "tpu", False, False, True)
+    assert strat == "hybrid"
+
+
+def test_score_span_carries_strategy_reason():
+    spec, w = _dense_exact_bigram()
+    events = []
+    sink = type("S", (), {"emit": lambda self, ev: events.append(ev)})()
+    REGISTRY.add_sink(sink)
+    try:
+        BatchRunner(
+            weights=jnp.asarray(w), lut=None, spec=spec,
+            length_buckets=(128,),
+        ).score([b"abc"])
+    finally:
+        REGISTRY.remove_sink(sink)
+    score_spans = [
+        ev for ev in events
+        if ev.get("event") == "telemetry.span" and ev.get("path") == "score"
+    ]
+    assert score_spans and score_spans[0]["strategy_reason"]
+
+
+# ------------------------------------------------------ degraded ladder -----
+def test_runner_fused_degraded_ladder_fused_gather_host():
+    """The fused strategy sits at the top of the degradation ladder: with
+    the fused dispatch AND the device-gather rung both failing, the host
+    rung carries the batch — bit-identical to the gather oracle (degraded
+    results never carry quantization error: the ladder reads the original
+    f32 tables)."""
+    spec, w = _dense_exact_bigram()
+    docs = _random_docs(8)[:8]
+    oracle = BatchRunner(
+        weights=jnp.asarray(w), lut=None, spec=spec,
+        batch_size=8, strategy="gather", length_buckets=(128, 256),
+    ).score(docs)
+
+    clk = {"t": 0.0}
+    runner = BatchRunner(
+        weights=jnp.asarray(w), lut=None, spec=spec,
+        batch_size=8, strategy="fused", quantization="int8",
+        length_buckets=(128, 256),
+        retry_policy=RetryPolicy(max_attempts=1, base_delay_s=0.0),
+        breaker=CircuitBreaker(
+            failure_threshold=1, cooldown_s=1e9, clock=lambda: clk["t"]
+        ),
+    )
+    # Fail the fused dispatch AND the ladder's device-gather rung (both
+    # count at score/dispatch): the host rung must carry the batch.
+    with faults.plan_scope(FaultPlan.parse("score/dispatch:error@1-2")):
+        got = runner.score(docs)
+    np.testing.assert_allclose(got, np.asarray(oracle), rtol=1e-5)
+    snap = REGISTRY.snapshot()
+    assert snap["counters"].get("resilience/degraded_host", 0) >= 1
+
+
+def test_runner_fused_degraded_gather_rung_exact():
+    """One injected fused failure with retries exhausted rides the
+    device-gather rung (not host) and stays exact."""
+    spec, w = _dense_exact_bigram()
+    docs = _random_docs(6)[:6]
+    oracle = BatchRunner(
+        weights=jnp.asarray(w), lut=None, spec=spec,
+        batch_size=8, strategy="gather", length_buckets=(128, 256),
+    ).score(docs)
+    before = REGISTRY.snapshot()["counters"].get(
+        "resilience/degraded_gather", 0
+    )
+    runner = BatchRunner(
+        weights=jnp.asarray(w), lut=None, spec=spec,
+        batch_size=8, strategy="fused", length_buckets=(128, 256),
+        retry_policy=RetryPolicy(max_attempts=1, base_delay_s=0.0),
+    )
+    with faults.plan_scope(FaultPlan.parse("score/dispatch:error@1")):
+        got = runner.score(docs)
+    np.testing.assert_allclose(got, np.asarray(oracle), rtol=1e-5)
+    after = REGISTRY.snapshot()["counters"].get(
+        "resilience/degraded_gather", 0
+    )
+    assert after == before + 1
+
+
+# ------------------------------------------------------ persist round trip --
+def test_quantized_persist_round_trip_scores_identical(tmp_path):
+    """save(quantized) → load → fused-quantized scores are bit-identical
+    to the pre-save model's (requantization is a fixed point), and the
+    loaded profile's f32 weights are exactly q * scale."""
+    from spark_languagedetector_tpu import LanguageDetector, Table
+    from spark_languagedetector_tpu.models.estimator import (
+        LanguageDetectorModel,
+    )
+
+    langs = ["en", "de", "fr"]
+    docs = ["the fox jumps", "der fuchs springt", "le renard saute"] * 10
+    labels = ["en", "de", "fr"] * 10
+    model = LanguageDetector(langs, [1, 2], 120).fit(
+        Table({"lang": labels, "fulltext": docs})
+    )
+    model.set_quantization("int8")
+    path = str(tmp_path / "m")
+    model.write().overwrite().quantized("int8").save(path)
+    loaded = LanguageDetectorModel.load(path)
+    assert loaded.get_or_default("quantization") == "int8"
+
+    q, scales = quantize_weights(model.profile.weights, "int8")
+    np.testing.assert_array_equal(
+        np.asarray(loaded.profile.weights, np.float32),
+        dequantize_weights(q, scales),
+    )
+    probe = [b"the quick fox", b"der schnelle fuchs", b"le renard rapide"]
+    np.testing.assert_array_equal(
+        model._get_runner().score(probe), loaded._get_runner().score(probe)
+    )
+
+
+def test_quantized_persist_rejects_reference_layout(tmp_path):
+    from spark_languagedetector_tpu.persist.io import save_model
+
+    profile = GramProfile.from_gram_map(
+        {b"ab": [0.5, 0.2]}, ("en", "de"), (2,)
+    )
+    with pytest.raises(ValueError, match="native-layout"):
+        save_model(
+            tmp_path / "m", profile, "uid", {}, layout="reference",
+            quantize="int8",
+        )
+
+
+# ------------------------------------------------------ serving hot-swap ----
+def test_registry_hot_swap_quantized_profile():
+    """A quantized model swaps into the serving registry like any other
+    version: parity against the f32 version's labels on the probe docs,
+    quantization surfaced in describe() (the /varz payload)."""
+    from spark_languagedetector_tpu import LanguageDetector, Table
+    from spark_languagedetector_tpu.serve.registry import ModelRegistry
+
+    langs = ["en", "de"]
+    docs = ["the quick brown fox", "der schnelle braune fuchs"] * 10
+    labels = ["en", "de"] * 10
+    model = LanguageDetector(langs, [1, 2], 120).fit(
+        Table({"lang": labels, "fulltext": docs})
+    )
+    reg = ModelRegistry(prewarm_docs=(b"warm up doc",))
+    v1 = reg.install(model)
+    qmodel = model.copy()
+    qmodel.set_quantization("int16")
+    v2 = reg.install(qmodel)
+    assert reg.current_version() == v2
+    versions = {v["version"]: v for v in reg.versions()}
+    assert versions[v1]["quantization"] is None
+    assert versions[v2]["quantization"] == "int16"
+    assert versions[v2]["strategy"] == "fused"
+    probe = [b"the brown fox jumps", b"der braune fuchs springt"]
+    with reg.lease() as entry:
+        got = entry.runner.predict_ids(probe)
+    want = model._get_runner().predict_ids(probe)
+    np.testing.assert_array_equal(got, want)

@@ -1328,6 +1328,80 @@ def test_compare_cli_fill_ratio_is_higher_better(tmp_path, capsys):
     assert c_main([str(b), str(a)]) == 0  # improved fill never flags
 
 
+def _table_capture(path, table_bytes, per_dispatch_s=0.01, bytes_accessed=8e6,
+                   quant="int8", strategy="fused"):
+    """Synthetic capture: one dispatch span + a snapshot carrying the
+    table-traffic gauges the guard tracks."""
+    events = [
+        {
+            "event": "telemetry.span", "ts": 1.0, "path": "score/dispatch",
+            "wall_s": per_dispatch_s,
+        },
+        {
+            "event": "telemetry.snapshot", "ts": 2.0, "counters": {},
+            "histograms": {},
+            "gauges": {
+                "langdetect_table_bytes": {
+                    f"program=score/dispatch,quant={quant},"
+                    f"strategy={strategy}": table_bytes,
+                },
+                "program_bytes_accessed": {
+                    "program=score/dispatch": bytes_accessed,
+                },
+                "device_peak_bytes_per_s": {"device=cpu": 5.0e10},
+            },
+        },
+    ]
+    path.write_text("".join(json.dumps(ev) + "\n" for ev in events))
+
+
+def test_compare_tracked_table_bytes_regression(tmp_path, capsys):
+    """A change that silently de-quantizes (table_bytes 4x) or re-balloons
+    a program's table traffic fails the guard even when every latency
+    percentile held steady. The tracked key is per PROGRAM: the
+    de-quantization also changes the gauge's quant/strategy labels, and
+    the regression must survive that label flip."""
+    from spark_languagedetector_tpu.telemetry.compare import main as c_main
+
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    _table_capture(a, 12.6e6, quant="int8", strategy="fused")
+    # De-quantized candidate: 4x the bytes AND different labels.
+    _table_capture(b, 50.4e6, quant="f32", strategy="gather")
+    assert c_main([str(a), str(b)]) == 1
+    assert "table_bytes[score/dispatch]" in capsys.readouterr().out
+    capsys.readouterr()
+    assert c_main([str(a), str(a)]) == 0  # identical captures pass
+
+
+def test_compare_tracked_bytes_utilization(tmp_path, capsys):
+    """est_bytes_utilization is re-derived from the capture exactly like
+    stage_summary joins it (bytes/call / per-call seconds / peak) and
+    regresses upward — more of the HBM roof consumed per dispatch."""
+    from spark_languagedetector_tpu.telemetry.compare import (
+        capture_stats,
+        main as c_main,
+    )
+    from spark_languagedetector_tpu.telemetry.report import load_events
+
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    _table_capture(a, 12.6e6, bytes_accessed=8e6)
+    _table_capture(b, 12.6e6, bytes_accessed=40e6)  # 5x the traffic
+    stats = capture_stats(load_events(str(a)))
+    key = "est_bytes_utilization[score/dispatch]"
+    assert stats["tracked"][key] == pytest.approx(8e6 / 0.01 / 5.0e10)
+    assert c_main([str(a), str(b)]) == 1
+    assert "est_bytes_utilization" in capsys.readouterr().out
+    # A tracked metric appearing in only one capture is informational.
+    plain = tmp_path / "plain.jsonl"
+    plain.write_text(json.dumps({
+        "event": "telemetry.span", "ts": 1.0, "path": "score/dispatch",
+        "wall_s": 0.01,
+    }) + "\n")
+    capsys.readouterr()
+    assert c_main([str(plain), str(a)]) == 0
+    assert "only in candidate" in capsys.readouterr().out
+
+
 def test_compare_cli_usage_and_io_errors(tmp_path, capsys):
     from spark_languagedetector_tpu.telemetry.compare import main as c_main
 

@@ -455,3 +455,87 @@ def test_blocked_topk_matches_host_order_on_hardware():
     if "skip" in result:
         pytest.skip(result["skip"])
     assert result["mismatches"] == [], result["mismatches"]
+
+
+_FUSED_SCRIPT = r"""
+import json, sys
+import numpy as np
+import jax, jax.numpy as jnp
+
+if jax.default_backend() == "cpu":
+    print(json.dumps({"skip": "no accelerator"}))
+    sys.exit(0)
+
+from spark_languagedetector_tpu.ops import score as S
+from spark_languagedetector_tpu.ops import score_fused as SF
+from spark_languagedetector_tpu.ops.encoding import pad_batch
+from spark_languagedetector_tpu.ops.vocab import EXACT, HASHED, VocabSpec
+
+rng = np.random.default_rng(37)
+docs = [b"", b"a", b"ab"] + [
+    bytes(rng.integers(0, 256, int(rng.integers(1, 700)), dtype=np.uint8))
+    for _ in range(29)
+]
+batch, lengths = pad_batch(docs, pad_to=1024)
+batch, lengths = jnp.asarray(batch), jnp.asarray(lengths)
+out = {}
+
+# Exact bigram dense (the config-1 headline form): ids fully in-kernel.
+spec = VocabSpec(EXACT, (1, 2))
+w = rng.normal(size=(spec.id_space_size, 5)).astype(np.float32)
+want = np.asarray(S.score_batch(batch, lengths, jnp.asarray(w), None, spec=spec))
+ft = SF.build_fused_tables(w, None, spec, None)
+got = np.asarray(SF.score_batch_fused(
+    batch, lengths, jnp.asarray(ft.wq), jnp.asarray(ft.scales), None, None,
+    spec=spec, layout=ft.layout,
+))
+out["exact_dense_err"] = float(np.abs(got - want).max())
+labels, best = SF.detect_batch_fused(
+    batch, lengths, jnp.asarray(ft.wq), jnp.asarray(ft.scales), None, None,
+    spec=spec, layout=ft.layout,
+)
+out["detect_label_mismatches"] = int(
+    (np.asarray(labels) != want.argmax(axis=1)).sum()
+)
+
+# Hashed exact12 LUT split (the production 2^20 form): in-kernel short-gram
+# ids + FNV-fold rows plane, int8 quantized tiles.
+spec = VocabSpec(HASHED, (1, 2, 3, 4, 5), hash_bits=18)
+n_rows = 4000
+lut = np.full(spec.id_space_size, n_rows, np.int32)
+ids = rng.choice(spec.id_space_size, n_rows, replace=False)
+lut[ids] = np.arange(n_rows)
+wc = np.zeros((n_rows + 1, 8), np.float32)
+wc[:-1] = rng.normal(size=(n_rows, 8)).astype(np.float32)
+want = np.asarray(S.score_batch(
+    batch, lengths, jnp.asarray(wc), jnp.asarray(lut), spec=spec
+))
+ft = SF.build_fused_tables(wc, lut, spec, None)
+got = np.asarray(SF.score_batch_fused(
+    batch, lengths, jnp.asarray(ft.wq), jnp.asarray(ft.scales),
+    jnp.asarray(ft.lut), None, spec=spec, layout=ft.layout,
+))
+out["exact12_lut_err"] = float(np.abs(got - want).max())
+ftq = SF.build_fused_tables(wc, lut, spec, "int8")
+gotq = np.asarray(SF.score_batch_fused(
+    batch, lengths, jnp.asarray(ftq.wq), jnp.asarray(ftq.scales),
+    jnp.asarray(ftq.lut), None, spec=spec, layout=ftq.layout,
+))
+out["int8_label_agreement"] = float(
+    (gotq.argmax(axis=1) == want.argmax(axis=1)).mean()
+)
+print(json.dumps(out))
+"""
+
+
+def test_fused_kernel_matches_gather_on_hardware():
+    """The fused detect megakernel's Mosaic lowering (in-kernel FNV fold,
+    streamed quantized table tiles, in-kernel argmax) vs the gather
+    reference on the real chip — the CPU suite only sees interpret mode."""
+    result = _run_on_device(_FUSED_SCRIPT)
+    if "skip" in result:
+        pytest.skip(result["skip"])
+    assert result["exact_dense_err"] < 1e-2
+    assert result["exact12_lut_err"] < 1e-2
+    assert result["detect_label_mismatches"] == 0
+    assert result["int8_label_agreement"] >= 0.999

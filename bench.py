@@ -660,15 +660,32 @@ def fit_bench(cfg, langs):
         dev_model = build().set_fit_backend("device").fit(table)
         t_dev_cold = time.perf_counter() - t0
         stages_before = REGISTRY.stage_summary()
-        wire_before = REGISTRY.snapshot()["counters"].get("fit/wire_bytes", 0)
+        counters_before = REGISTRY.snapshot()["counters"]
+        wire_before = counters_before.get("fit/wire_bytes", 0)
+        collect_before = counters_before.get("fit/collect_bytes", 0)
         t0 = time.perf_counter()
         dev_model = build().set_fit_backend("device").fit(table)
         t_dev = time.perf_counter() - t0
         stages = _fit_stage_delta(stages_before, REGISTRY.stage_summary())
-        wire_mb = (
-            REGISTRY.snapshot()["counters"].get("fit/wire_bytes", 0)
-            - wire_before
-        ) / 1e6
+        counters_after = REGISTRY.snapshot()["counters"]
+        wire_mb = (counters_after.get("fit/wire_bytes", 0) - wire_before) / 1e6
+        # Winner-rows-only collect: bytes the finalize actually pulled back
+        # vs the full [V, L] table the pre-device-finalize fit fetched
+        # (docs/PERFORMANCE.md §8). The ratio is only well-defined for
+        # single-dense-table specs (the split exact n>=4 fit counts its
+        # long grams on host).
+        collect_bytes = (
+            counters_after.get("fit/collect_bytes", 0) - collect_before
+        )
+        spec = build()._vocab_spec()
+        from spark_languagedetector_tpu.ops.vocab import (
+            EXACT as _EXACT,
+            MAX_DEVICE_ID_GRAM_LEN as _MAXDEV,
+        )
+
+        dense_spec = not (
+            spec.mode == _EXACT and max(spec.gram_lengths) > _MAXDEV
+        )
         ids_match = np.array_equal(
             host_model.profile.ids, dev_model.profile.ids
         )
@@ -684,8 +701,13 @@ def fit_bench(cfg, langs):
             "fit_device_cold_s": round(t_dev_cold, 1),
             "fit_train_docs": n,
             "fit_wire_mb": round(wire_mb, 2),
+            "fit_collect_bytes": int(collect_bytes),
             "fit_stages": stages,
         }
+        if dense_spec and collect_bytes:
+            table_bytes = spec.id_space_size * len(langs) * 4
+            out["fit_collect_table_bytes"] = int(table_bytes)
+            out["fit_collect_ratio"] = round(collect_bytes / table_bytes, 6)
         out.update(fit_compute_only(cfg, langs, docs[:4096], labels[:4096]))
         return out
     except Exception as e:  # diagnostic leg: degrade, don't kill the config
@@ -1428,6 +1450,265 @@ def smoke_serve(jsonl_path: str | None = None) -> dict:
     return result
 
 
+def smoke_refit(jsonl_path: str | None = None) -> dict:
+    """CPU-safe continuous-learning smoke: the full data-in → model-out →
+    serving loop under one gate (ROADMAP item 2).
+
+    Drives a labeled micro-batch stream through the incremental refit
+    engine: streaming accumulator updates via the pipelined count path,
+    per-batch crash-atomic checkpoints, a mid-stream kill + resume from
+    the persisted accumulator (the resume token rides inside the state),
+    periodic refits that re-run only the on-device finalize, and every
+    refit hot-swapped into a live ``serve.ModelRegistry``.
+
+    Hard gates (``main()`` exits nonzero): the final served profile must
+    be BIT-IDENTICAL (ids and float64 weights) to a from-scratch
+    ``fit`` over the concatenation of every streamed batch; the resumed
+    run must actually fast-forward (``resumed_from > 0``) and re-count
+    nothing; the registry must serve the last refit with its refit token
+    in the swap metadata; and the finalize collect must move only winner
+    rows (``collect.ratio`` well under 1 — the §8 fit-collect-wall
+    contract, also enforced capture-over-capture by the compare guard's
+    ``langdetect_fit_collect_bytes`` tracking).
+    """
+    import shutil
+    import tempfile
+
+    from spark_languagedetector_tpu import LanguageDetector, Table
+    from spark_languagedetector_tpu.serve import ModelRegistry
+    from spark_languagedetector_tpu.stream import AutoRefit
+    from spark_languagedetector_tpu.telemetry import REGISTRY
+    from spark_languagedetector_tpu.telemetry.export import JsonlSink
+
+    REGISTRY.reset()
+    path = jsonl_path or os.path.join(
+        tempfile.gettempdir(), f"refit_smoke_{os.getpid()}.jsonl"
+    )
+    sink = JsonlSink(path)
+    REGISTRY.add_sink(sink)
+    tmpdir = tempfile.mkdtemp(prefix="refit_smoke_")
+    state_path = os.path.join(tmpdir, "fit_state")
+
+    langs = language_names(3)
+    docs, labels = make_corpus(langs, 120, mean_len=200, seed=5)
+    batch_rows = 12
+    batches = [
+        Table({"lang": labels[lo:lo + batch_rows],
+               "fulltext": docs[lo:lo + batch_rows]})
+        for lo in range(0, len(docs), batch_rows)
+    ]
+
+    def det():
+        return (
+            LanguageDetector(langs, [1, 2], 300)
+            .set_vocab_mode("hashed")
+            .set_hash_bits(12)
+            .set_fit_backend("device")
+        )
+
+    errors: list[str] = []
+    try:
+        registry = ModelRegistry(drain_timeout_s=2.0)
+        # Phase 1: stream the first 4 batches with per-batch checkpoints
+        # and a refit+hot-swap every 2 — then stop (the simulated kill:
+        # the process state is discarded, only the checkpoint survives).
+        first = AutoRefit(
+            det(), registry, state_path=state_path,
+            refit_every_batches=2, final_refit=False,
+        )
+        first.run(batches, max_batches=4)
+        phase1_refits = first.progress.refits
+        phase1_version = first.progress.last_version
+        del first
+
+        # Phase 2: a fresh driver on the same state resumes past the 4
+        # committed batches (re-counting nothing) and streams the rest.
+        second = AutoRefit(
+            det(), registry, state_path=state_path, refit_every_batches=3,
+        )
+        progress = second.run(batches)
+        resumed_from = progress.resumed_from
+
+        # From-scratch oracle over the concatenated corpus.
+        scratch = det().fit(
+            Table({"lang": labels, "fulltext": docs})
+        )
+        served = registry.peek()
+        served_profile = served.model.profile
+        ids_ok = np.array_equal(served_profile.ids, scratch.profile.ids)
+        weights_ok = ids_ok and np.array_equal(
+            served_profile.weights, scratch.profile.weights
+        )
+        if not weights_ok:
+            errors.append("refit profile != from-scratch fit (bit-exact)")
+        if resumed_from != 4:
+            errors.append(f"resume fast-forwarded {resumed_from} != 4")
+        meta = served.describe().get("metadata") or {}
+        if meta.get("refit_token") != len(batches):
+            errors.append(
+                f"served refit_token {meta.get('refit_token')} != "
+                f"{len(batches)}"
+            )
+
+        snap = REGISTRY.snapshot()
+        collect_bytes = snap["counters"].get("fit/collect_bytes", 0)
+        spec = det()._vocab_spec()
+        table_bytes = spec.id_space_size * len(langs) * 4
+        finalizes = max(phase1_refits + progress.refits + 1, 1)  # + scratch
+        per_fit = collect_bytes / finalizes
+        ratio = per_fit / table_bytes
+        if not ratio < 0.5:
+            errors.append(
+                f"collect moved {per_fit:.0f}B/fit vs {table_bytes}B table "
+                "— winner-rows-only collect regressed"
+            )
+
+        result = {
+            "smoke_refit": True,
+            "batches": len(batches),
+            "docs": len(docs),
+            "refits": phase1_refits + progress.refits,
+            "resumed_from": resumed_from,
+            "versions": [v["version"] for v in registry.versions()],
+            "served_version": served.version,
+            "phase1_version": phase1_version,
+            "refit_token": meta.get("refit_token"),
+            "parity_ok": weights_ok,
+            "collect": {
+                "bytes_per_fit": round(per_fit, 1),
+                "full_table_bytes": table_bytes,
+                "ratio": round(ratio, 6),
+            },
+            "errors": errors[:5],
+            "telemetry": telemetry_block(path),
+        }
+        result["ok"] = not errors
+        return result
+    finally:
+        REGISTRY.remove_sink(sink)
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def fit_scaling_probe(n_devices: int) -> dict:
+    """Child half of the fit-scaling leg: run in a subprocess whose
+    XLA_FLAGS forced ``n_devices`` virtual CPU devices. Fits the probe
+    corpus through the public estimator (device backend — >1 device
+    resolves the fit mesh, so 8 devices exercise the table-sharded
+    accumulator + collective top-k merge), reports warm docs/s, the
+    fit-stage breakdown including ``fit/finalize``/``fit/collect``, and
+    the collect-bytes contract numbers."""
+    import jax
+
+    # The axon sitecustomize force-sets jax_platforms programmatically; the
+    # programmatic update (not the env var) is what actually wins — same
+    # dance as tests/conftest.py.
+    jax.config.update("jax_platforms", "cpu")
+
+    from spark_languagedetector_tpu import LanguageDetector, Table
+    from spark_languagedetector_tpu.telemetry import REGISTRY
+
+    devices = jax.devices()
+    if len(devices) < n_devices:
+        return {"error": f"wanted {n_devices} devices, have {len(devices)}"}
+    langs = language_names(6)
+    docs, labels = make_corpus(langs, 240, mean_len=300, seed=7)
+    table = Table({"lang": labels, "fulltext": docs})
+
+    def det(backend):
+        return (
+            LanguageDetector(langs, [1, 2, 3], 400)
+            .set_vocab_mode("hashed")
+            .set_hash_bits(16)
+            .set_fit_backend(backend)
+        )
+
+    host_model = det("cpu").fit(table)
+    dev_model = det("device").fit(table)  # cold (compiles)
+    stages_before = REGISTRY.stage_summary()
+    collect_before = REGISTRY.snapshot()["counters"].get(
+        "fit/collect_bytes", 0
+    )
+    t0 = time.perf_counter()
+    dev_model = det("device").fit(table)
+    t_warm = time.perf_counter() - t0
+    stages = _fit_stage_delta(stages_before, REGISTRY.stage_summary())
+    collect_bytes = (
+        REGISTRY.snapshot()["counters"].get("fit/collect_bytes", 0)
+        - collect_before
+    )
+    spec = det("device")._vocab_spec()
+    table_bytes = spec.id_space_size * len(langs) * 4
+    parity = np.array_equal(
+        dev_model.profile.ids, host_model.profile.ids
+    ) and np.array_equal(dev_model.profile.weights, host_model.profile.weights)
+    return {
+        "devices": n_devices,
+        "fit_docs_per_s": round(len(docs) / t_warm, 1),
+        "fit_train_docs": len(docs),
+        "fit_stages": stages,
+        "fit_collect_bytes": int(collect_bytes),
+        # What the pre-device-finalize fit moved per finalize: the whole
+        # [V, L] table — the "before" of the before/after collect ratio.
+        "full_table_bytes": int(table_bytes),
+        "collect_ratio": round(collect_bytes / table_bytes, 6),
+        "parity_vs_host": bool(parity),
+    }
+
+
+def fit_scaling() -> dict:
+    """Fit-scaling leg: device fit docs/s and collect bytes on a 1-device
+    vs an 8-virtual-device CPU mesh (the test substrate's geometry).
+
+    Each leg runs in a subprocess because the virtual device count is an
+    XLA startup flag. The capture records the before/after collect story:
+    ``full_table_bytes`` is what the host finalize used to pull per fit,
+    ``fit_collect_bytes`` is what the winner-rows-only device finalize
+    moves now, ``collect_ratio`` their quotient — on BOTH geometries (the
+    8-device leg's finalize is the cross-shard collective merge). Gated
+    on parity with the host fit and on the ratio staying well under 1.
+    """
+    import subprocess
+
+    results: dict[str, dict] = {}
+    for n in (1, 8):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        base = env.get("XLA_FLAGS", "")
+        base = " ".join(
+            p for p in base.split()
+            if "xla_force_host_platform_device_count" not in p
+        )
+        env["XLA_FLAGS"] = (
+            f"{base} --xla_force_host_platform_device_count={n}".strip()
+        )
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--fit-scaling-probe", str(n)],
+            capture_output=True, text=True, timeout=1200, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if proc.returncode != 0:
+            tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
+            results[str(n)] = {"error": " | ".join(tail)}
+            continue
+        results[str(n)] = json.loads(proc.stdout.strip().splitlines()[-1])
+    ok = all(
+        r.get("parity_vs_host") and r.get("collect_ratio", 1.0) < 0.5
+        for r in results.values()
+    )
+    one, eight = results.get("1", {}), results.get("8", {})
+    out = {
+        "fit_scaling": results,
+        "scaling_1_to_8": (
+            round(eight["fit_docs_per_s"] / one["fit_docs_per_s"], 3)
+            if one.get("fit_docs_per_s") and eight.get("fit_docs_per_s")
+            else None
+        ),
+        "ok": ok,
+    }
+    return out
+
+
 # ------------------------------------------------------------ per config ----
 CONFIGS = {
     # cap: ship maxScoreBytes=256 on the headline config — language identity
@@ -2100,6 +2381,50 @@ def main():
             )
             sys.exit(1)
         return
+    if "--smoke-refit" in sys.argv[1:]:
+        # Continuous-learning smoke: streaming accumulator updates,
+        # checkpointed resume after a simulated kill, periodic refits
+        # hot-swapped into a live registry — hard-gated on bit-exact
+        # parity with a from-scratch fit and on the winner-rows-only
+        # collect contract.
+        args = [a for a in sys.argv[1:] if a != "--smoke-refit"]
+        flags = [a for a in args if a.startswith("-")]
+        if flags or len(args) > 1:
+            print(
+                f"usage: python bench.py --smoke-refit [out.jsonl] "
+                f"(got {args})",
+                file=sys.stderr,
+            )
+            sys.exit(2)
+        result = smoke_refit(args[0] if args else None)
+        print(json.dumps(result), flush=True)
+        if not result["ok"]:
+            print(
+                "refit smoke FAILED: "
+                + ("; ".join(result["errors"]) or "gate not met"),
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        return
+    if "--fit-scaling-probe" in sys.argv[1:]:
+        # Child half of --fit-scaling (device count is an XLA startup
+        # flag, so each geometry needs its own process).
+        idx = sys.argv.index("--fit-scaling-probe")
+        n = int(sys.argv[idx + 1])
+        print(json.dumps(fit_scaling_probe(n)), flush=True)
+        return
+    if "--fit-scaling" in sys.argv[1:]:
+        # Fit-scaling leg: 1-device vs 8-device CPU mesh fit throughput +
+        # the before/after collect-bytes contract on both geometries.
+        result = fit_scaling()
+        print(json.dumps(result), flush=True)
+        if not result["ok"]:
+            print(
+                "fit scaling FAILED: parity or collect-ratio gate not met",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        return
     order = [
         int(c)
         for c in os.environ.get("BENCH_CONFIGS", "2,3,4,5,1").split(",")
@@ -2144,7 +2469,8 @@ def main():
                     "hashed_vs_exact_agreement",
                     "hashed_vs_exact_shortdoc_delta",
                     "fit_docs_per_s_host", "fit_docs_per_s_device",
-                    "fit_wire_mb", "fit_compute_docs_per_s",
+                    "fit_wire_mb", "fit_collect_bytes", "fit_collect_ratio",
+                    "fit_compute_docs_per_s",
                     "fit_device_mismatch", "max_score_bytes",
                     "accuracy_fulllen", "cap_accuracy_delta",
                     "cap_mixed_delta", "compute_docs_per_s_fulllen",

@@ -304,6 +304,11 @@ class LanguageDetector(_DetectorParams):
 
             save_gram_dump(save_path, profile)
 
+        return self._build_model(profile)
+
+    def _build_model(self, profile: GramProfile) -> "LanguageDetectorModel":
+        """Profile → configured model — the estimator-configures-model tail
+        shared by ``fit`` and ``fit_from_accumulator``."""
         model = LanguageDetectorModel(profile)
         model.set_default(inputCol=self.get_or_default("inputCol"))
         if self.is_set("backend"):
@@ -311,6 +316,63 @@ class LanguageDetector(_DetectorParams):
         if self.is_set("quantization"):
             model.set("quantization", self.get("quantization"))
         return model
+
+    # -- incremental refit -----------------------------------------------------
+    def accumulator(self) -> "FitAccumulator":
+        """An empty incremental-fit accumulator configured exactly like this
+        estimator's device fit (spec, languages, weight mode, profile size,
+        encoding, batch rows, fit mesh). Feed it batches with
+        ``acc.update(table)`` — the same pipelined count path ``fit`` uses —
+        then :meth:`fit_from_accumulator`. See ``models.refit``."""
+        from .refit import FitAccumulator
+
+        return FitAccumulator.for_estimator(self)
+
+    def fit_from_accumulator(self, acc: "FitAccumulator") -> "LanguageDetectorModel":
+        """Model from an accumulated count table: re-runs only the on-device
+        finalize (weighting + collective top-k + winner-rows collect) — bit-
+        identical to ``fit`` on the concatenation of every batch the
+        accumulator has seen. The accumulator must have been built under
+        this estimator's exact fit configuration, and every supported
+        language must have coverage (the same validation ``fit`` applies)."""
+        if not acc.matches_estimator(self):
+            raise ValueError(
+                "accumulator state does not match this estimator's fit "
+                "configuration (vocab spec / languages / weightMode / "
+                "languageProfileSize); refit needs the exact fit setup "
+                "its counts were accumulated under"
+            )
+        # Same transient-failure story as fit: finalize reads the count
+        # table without donating it, so it is idempotent and replays
+        # exactly under the env-tuned policy (the auto-refit daemon must
+        # not die on a retryable device hiccup mid-refit).
+        from ..resilience.policy import RetryPolicy
+
+        policy = RetryPolicy.from_env()
+        try:
+            with trace_request(), span(
+                "fit",
+                rows=acc.docs_seen,
+                backend="device",
+                incremental=True,
+                languages=len(acc.languages),
+            ):
+                ids, weights = policy.run(
+                    acc.finalize,
+                    site="fit/finalize",
+                    log_fields={"rows": acc.docs_seen},
+                )
+        except Exception as e:
+            flightrec.record_crash("fit", e)
+            raise
+        profile = GramProfile(
+            spec=acc.spec, languages=acc.languages, ids=ids, weights=weights
+        )
+        log_event(
+            _log, "refit.done", rows=acc.docs_seen, grams=profile.num_grams,
+            languages=len(acc.languages), committed=acc.committed,
+        )
+        return self._build_model(profile)
 
     def _fit_profile(self, spec, docs, lang_idx, supported):
         """(ids, weights) via the configured fit backend — the body of the

@@ -14,12 +14,13 @@ exact vocabs with max length ≤ 2 use this path end-to-end; exact trigram
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 
-from ..telemetry import span
+from ..telemetry import REGISTRY, span
 from ..telemetry.gauges import note_donation_reuse
 from .vocab import VocabSpec, partial_window_ids, window_ids
 
@@ -151,11 +152,15 @@ def _block_top_k(blk: jnp.ndarray, k: int, id_offset: int):
     return gvals, rows.astype(jnp.int32) + id_offset
 
 
-def _final_candidates_top_k(cv: jnp.ndarray, ci: jnp.ndarray, k: int):
+def _candidates_top_k(cv: jnp.ndarray, ci: jnp.ndarray, k: int):
     """Top-k over (value, real-id) candidate pairs under the (value desc,
     id asc) total order: value top-k for the strictly-above entries, then
     the boundary plateau re-ranked by the candidates' REAL ids (not
-    positions) so global tie order holds."""
+    positions) so global tie order holds. Returns (values [L, k],
+    ids [L, k]) — the values ride along so the selection composes: a
+    shard's candidates can themselves be merged by a further
+    ``_candidates_top_k`` (the cross-shard collective merge) without
+    re-deriving them."""
     fvals, fidx = jax.lax.top_k(cv, k)
     w_star = fvals[:, k - 1 : k]
     n_above = (cv > w_star).sum(axis=1, keepdims=True)
@@ -165,11 +170,46 @@ def _final_candidates_top_k(cv: jnp.ndarray, ci: jnp.ndarray, k: int):
     j = jnp.arange(k, dtype=jnp.int32)[None, :]  # never selected (see proof
     shifted = jnp.clip(j - n_above, 0, k - 1)  # in top_k_rows_blocked)
     above_ids = jnp.take_along_axis(ci, fidx, axis=1)
-    return jnp.where(
+    ids = jnp.where(
         j < n_above,
         above_ids,
         jnp.take_along_axis(plateau_ids, shifted, axis=1),
     ).astype(jnp.int32)
+    # Selected plateau slots all sit exactly at the boundary value.
+    vals = jnp.where(j < n_above, fvals, jnp.broadcast_to(w_star, fvals.shape))
+    return vals, ids
+
+
+def _final_candidates_top_k(cv: jnp.ndarray, ci: jnp.ndarray, k: int):
+    return _candidates_top_k(cv, ci, k)[1]
+
+
+def shard_topk_candidates(
+    masked: jnp.ndarray, k: int, id_offset, *, block: int = 1 << 21
+):
+    """One vocab shard's top-k candidates (values [L, k], GLOBAL ids [L, k])
+    under the (value desc, id asc) total order — the per-shard half of the
+    distributed finalize (``parallel.sharded.make_sharded_finalize_topk``).
+    ``id_offset`` (python int or traced int32 — inside shard_map it is
+    ``axis_index * rows_per_shard``) lifts local row indices to global gram
+    ids, so the cross-shard merge ranks ties by REAL id and the collective
+    finalize keeps the host fit's lowest-index tie order. Shards wider than
+    ``block`` walk in blocks to bound the lax.top_k sort temp, exactly like
+    :func:`top_k_rows_blocked`."""
+    wT = masked.T  # [L, Vs]
+    L, Vs = wT.shape
+    if Vs <= block:
+        return _block_top_k(wT, k, id_offset)
+    cand_v, cand_i = [], []
+    for s in range(0, Vs, block):
+        blk = wT[:, s : s + block]
+        bk = min(k, blk.shape[1])
+        bv, bi = _block_top_k(blk, bk, id_offset + s)
+        cand_v.append(bv)
+        cand_i.append(bi)
+    cv = jnp.concatenate(cand_v, axis=1)
+    ci = jnp.concatenate(cand_i, axis=1)
+    return _candidates_top_k(cv, ci, k)
 
 
 @partial(jax.jit, static_argnames=("k", "block"))
@@ -284,6 +324,280 @@ _fit_dense_step_donated = partial(
 )(fit_dense_step)
 
 
+@dataclass
+class DeviceFitContext:
+    """How one device fit (or incremental refit) runs: the zero accumulator,
+    the count step, batch placement, and whether the [V, L] table is sharded
+    over the mesh's table axis. Built once per fit by
+    :func:`device_fit_context` and shared by ``fit_profile_device`` and the
+    incremental ``models.refit.FitAccumulator`` so the two paths can never
+    drift."""
+
+    counts: jnp.ndarray
+    step: object
+    placement: object
+    ndata: int
+    donate: bool
+    table_sharded: bool
+    mesh: object
+
+
+def device_fit_context(
+    spec: VocabSpec, num_langs: int, mesh=None
+) -> DeviceFitContext:
+    """Resolve the count-step machinery for a (spec, mesh) pair.
+
+    ``mesh``: batches shard over its data axis; the count accumulator
+    shards over the TABLE axis (``parallel.mesh.table_axis`` — the vocab
+    axis when it has devices, else the data axis) whenever the id space
+    divides evenly and the mesh is single-process, which turns the
+    per-step GSPMD count reduction into a reduce-scatter and bounds each
+    device's finalize to V/shards rows. Multi-process meshes (and
+    non-dividing id spaces) keep the replicated accumulator — every
+    process must enqueue identical collectives, and the replicated form
+    is the one whose schedule is pinned by the lockstep story.
+    """
+    V = spec.id_space_size
+    counts = jnp.zeros((V, num_langs), dtype=jnp.int32)
+    step = fit_dense_step
+    ndata = 1
+    donate = False
+    placement = None
+    table_sharded = False
+    if mesh is not None:
+        from ..parallel.mesh import (
+            DATA_AXIS,
+            batch_sharding,
+            replicated,
+            table_shards,
+            table_sharding,
+        )
+        from ..parallel.sharded import make_sharded_fit_step
+
+        ndata = int(mesh.shape[DATA_AXIS])
+        nshards = table_shards(mesh)
+        table_sharded = (
+            nshards > 1 and V % nshards == 0 and jax.process_count() == 1
+        )
+        acc_sharding = table_sharding(mesh) if table_sharded else replicated(mesh)
+        counts = jax.device_put(counts, acc_sharding)
+        placement = batch_sharding(mesh)
+        sharded = make_sharded_fit_step(
+            mesh, spec, num_langs, shard_table=table_sharded
+        )
+
+        def step(batch, lengths, lang_ids, acc, **_):
+            return sharded(batch, lengths, lang_ids, acc)
+
+    elif jax.devices()[0].platform != "cpu":
+        step = _fit_dense_step_donated
+        donate = True
+    return DeviceFitContext(
+        counts, step, placement, ndata, donate, table_sharded, mesh
+    )
+
+
+def accumulate_counts(
+    ctx: DeviceFitContext,
+    counts,
+    byte_docs,
+    lang_arr,
+    *,
+    spec: VocabSpec,
+    num_langs: int,
+    batch_rows: int | None = None,
+    extra_counts=None,
+):
+    """One pipelined counting pass: ``counts += counts(byte_docs)``.
+
+    The count half of the device fit, factored out so the incremental
+    refit engine updates its persisted accumulator through the *same*
+    plan/pack/put/count pipeline (``ops.fit_pipeline``) the from-scratch
+    fit uses — int32 scatter-add is order- and batching-independent, which
+    is what makes refit ≡ from-scratch bit-exact. Chunk-split straddle
+    windows and caller ``extra_counts`` ride the one-shot scatter at the
+    end of the pass.
+    """
+    import numpy as np
+
+    from .fit_pipeline import (
+        iter_device_batches,
+        plan_fit_batches,
+        resolve_fit_batching,
+    )
+
+    fixed_rows, byte_budget = resolve_fit_batching(batch_rows)
+    items, item_langs, plan, straddle = plan_fit_batches(
+        byte_docs, lang_arr, spec,
+        batch_rows=fixed_rows, byte_budget=byte_budget,
+    )
+    # (rows, pad_to) -> dispatch count: exactly the compiled-shape set, so
+    # the roofline gauges below bill the loop's true cost (billing every
+    # step at the largest shape overstates small/tail steps by orders of
+    # magnitude).
+    step_shapes: dict[tuple[int, int], int] = {}
+    with span(
+        "fit/count", docs=len(byte_docs), backend="device", shards=ctx.ndata,
+        batches=len(plan),
+    ) as count_span:
+        from ..resilience import faults
+
+        # Pipelined ingest (ops.fit_pipeline): the packer thread keeps ≥2
+        # packed-and-transferring batches ahead of this loop; ragged
+        # transfer applies on single-device dispatch only (a mesh shards
+        # the padded batch itself — same rule as the scoring runner).
+        batches = iter_device_batches(
+            items, item_langs, plan,
+            placement=ctx.placement, ragged=ctx.mesh is None, ndata=ctx.ndata,
+            parent=count_span.parent,
+        )
+        try:
+            for batch, lengths, langs, rows, pad_to in batches:
+                faults.inject("fit/count")  # chaos: one call per count step
+                key = (rows, pad_to)
+                step_shapes[key] = step_shapes.get(key, 0) + 1
+                prev = counts
+                counts = ctx.step(
+                    batch, lengths, langs, counts,
+                    spec=spec, num_langs=num_langs,
+                )
+                if ctx.donate:
+                    note_donation_reuse(prev)
+        finally:
+            # Deterministic teardown: an injected/count-step failure stops
+            # the packer thread before the error leaves this frame, so the
+            # estimator-level replay starts from a clean slate.
+            batches.close()
+        # Count dispatch is async: fencing (opt-in) bills the span the
+        # device_s through the last batch's completion.
+        count_span.fence(counts)
+
+    # Boundary windows severed by oversized-doc chunk-splitting ride the
+    # same one-shot scatter as caller-provided extra counts (duplicate
+    # (id, lang) pairs accumulate — scatter-add semantics).
+    if straddle is not None:
+        if extra_counts is None:
+            extra_counts = straddle
+        else:
+            extra_counts = tuple(
+                np.concatenate(
+                    [np.asarray(a, dtype=np.int64), np.asarray(b, dtype=np.int64)]
+                )
+                for a, b in zip(extra_counts, straddle)
+            )
+
+    # Roofline gauges for the count loop (single-device only — the GSPMD
+    # program's cost model is per-process): summed per-shape program cost
+    # over the shapes the loop actually dispatched, in the same units as
+    # the fit/count span. Diagnostics; never fatal.
+    if ctx.mesh is None and step_shapes:
+        try:
+            from ..telemetry import cost as cost_mod
+
+            cost_mod.record_fit_count_cost(spec, num_langs, step_shapes)
+        except Exception:
+            pass
+
+    if extra_counts is not None:
+        e_ids, e_langs, e_counts = (
+            jnp.asarray(np.asarray(a, dtype=np.int32)) for a in extra_counts
+        )
+        if e_ids.size:
+            counts = counts.at[e_ids, e_langs].add(e_counts)
+    return counts
+
+
+def finalize_counts(
+    counts,
+    *,
+    num_langs: int,
+    profile_size: int,
+    weight_mode: str = "parity",
+    mesh=None,
+    table_sharded: bool = False,
+):
+    """Count table → (sorted gram ids [G], float64 weights [G, L]) without
+    the full ``[V, L]`` table ever crossing the device→host wire.
+
+    The reduce half of the fit, entirely on device: weighting + per-language
+    top-k — vocab-sharded per-shard blocked top-k with a cross-shard
+    collective candidate merge when ``table_sharded`` (ids stay REAL through
+    the merge, so the host fit's lowest-index tie order is preserved across
+    any shard geometry), the single-program blocked/naive selection
+    otherwise. Only the compact winner rows (ids + their exact int32
+    counts — ``k·L`` rows, not ``V``) are then fetched in ``fit/collect``,
+    measured as the ``fit/collect_bytes`` counter and the
+    ``langdetect_fit_collect_bytes`` gauge (``telemetry/compare.py`` tracks
+    the gauge as an upward-regressing contract metric: a silent fall-back
+    to a full-table collect fails the guard). Winner weights are recomputed
+    on host in float64 from the exact integer counts, same as the
+    historical path — bit-identical to the host fit.
+    """
+    import numpy as np
+
+    V = int(counts.shape[0])
+    k = min(profile_size, V)
+    nshards = 1
+    topk_fn = None
+    if mesh is not None and table_sharded:
+        from ..parallel.mesh import table_shards
+        from ..parallel.sharded import make_sharded_finalize_topk
+
+        nshards = table_shards(mesh)
+        topk_fn = make_sharded_finalize_topk(
+            mesh, profile_size=k, weight_mode=weight_mode
+        )
+    # Non-occurred rows are not candidates (the reference's table only holds
+    # grams seen in training); they mask below any real weight for top-k.
+    with span(
+        "fit/finalize", backend="device", k=k, vocab=V, shards=nshards
+    ) as fin_span:
+        if topk_fn is not None:
+            top = topk_fn(counts)
+        elif V * num_langs > TOPK_SORT_BUDGET_ELEMS:
+            # Big tables (config-3 scale): the scanned finalize never
+            # materializes the [V, L] weight table and bounds the top-k sort
+            # per vocab block; ties → lowest id either way.
+            top = finalize_topk_blocked(counts, weight_mode=weight_mode, k=k)
+        else:
+            masked = masked_candidate_weights(counts, weight_mode=weight_mode)
+            top = top_k_rows(masked, k=k)  # ties → lowest id (re-ranked)
+        fin_span.fence(top)
+
+    top_np = np.unique(np.asarray(top).reshape(-1))
+    top_np = top_np[top_np < V]  # blocked-path pad rows carry ids >= V
+    # Recompute winner weights on host in float64 from the exact integer
+    # counts (see docstring) instead of fetching the device's float32 table;
+    # the same gathered rows decide occurrence (non-occurred candidates
+    # surface only for languages with fewer than k real grams).
+    with span("fit/collect", winners=int(top_np.size)) as col_span:
+        counts_sel_dev = counts[jnp.asarray(top_np)]
+        counts_sel = np.asarray(counts_sel_dev, dtype=np.int64)
+        # Bytes that actually cross to the host: the [L, k] winner ids and
+        # the [winners, L] int32 count rows — vs the V·L·4 full table the
+        # pre-device-finalize fit pulled back.
+        collect_bytes = int(top.nbytes) + int(counts_sel_dev.nbytes)
+        table_bytes = V * num_langs * 4
+        col_span.set(bytes=collect_bytes, table_bytes=table_bytes)
+        REGISTRY.incr("fit/collect_bytes", collect_bytes)
+        REGISTRY.set_gauge(
+            "langdetect_fit_collect_bytes", float(collect_bytes),
+            program="fit/collect",
+        )
+        occurred_np = counts_sel.sum(axis=1) > 0
+        rows = top_np[occurred_np]  # dense row index == gram id
+        counts_rows = counts_sel[occurred_np]
+        if weight_mode == "parity":
+            present = counts_rows > 0
+            nlangs = present.sum(axis=1, keepdims=True)
+            ratio = np.where(present, 1.0 / np.maximum(nlangs, 1), 0.0)
+        else:
+            totals = counts_rows.sum(axis=1, keepdims=True)
+            ratio = counts_rows / np.maximum(totals, 1)
+        weights = np.log1p(ratio.astype(np.float64))
+    return rows.astype(np.int64), weights
+
+
 def fit_profile_device(
     byte_docs,
     lang_indices,
@@ -326,9 +640,16 @@ def fit_profile_device(
     weights take |L|+1 discrete values).
 
     ``mesh``: optional ``jax.sharding.Mesh`` — batches shard over its "data"
-    axis and the count table stays replicated; GSPMD inserts the cross-shard
-    psum (the TPU-native analog of the reference's groupByKey shuffles,
-    LanguageDetector.scala:52-66). Pad rows (empty docs) contribute nothing.
+    axis and the count table stripes over the TABLE axis
+    (``device_fit_context``: single-process meshes whose id space divides
+    the shard count — the per-step GSPMD reduction is then a
+    reduce-scatter, each device finalizes its own V/shards stripe through
+    the collective top-k merge, and only winner rows reach the host).
+    Multi-process meshes and non-dividing id spaces keep the replicated
+    table + unsharded finalize (the lockstep collective schedule). Either
+    way the collectives are what GSPMD derives — the TPU-native analog of
+    the reference's groupByKey shuffles (LanguageDetector.scala:52-66).
+    Pad rows (empty docs) contribute nothing.
 
     ``extra_counts``: optional (ids [E], langs [E], counts [E]) arrays
     scatter-added into the dense table once — the split long-gram fit uses
@@ -337,148 +658,21 @@ def fit_profile_device(
     """
     import numpy as np
 
-    from .fit_pipeline import (
-        iter_device_batches,
-        plan_fit_batches,
-        resolve_fit_batching,
-    )
-
-    V = spec.id_space_size
-    counts = jnp.zeros((V, num_langs), dtype=jnp.int32)
-    step = fit_dense_step
-    ndata = 1
-    donate = False
-    placement = None
-    if mesh is not None:
-        from ..parallel.mesh import DATA_AXIS, batch_sharding, replicated
-        from ..parallel.sharded import make_sharded_fit_step
-
-        ndata = int(mesh.shape[DATA_AXIS])
-        counts = jax.device_put(counts, replicated(mesh))
-        placement = batch_sharding(mesh)
-        sharded = make_sharded_fit_step(mesh, spec, num_langs, shard_vocab=False)
-
-        def step(batch, lengths, lang_ids, acc, **_):
-            return sharded(batch, lengths, lang_ids, acc)
-
-    elif jax.devices()[0].platform != "cpu":
-        step = _fit_dense_step_donated
-        donate = True
-
+    ctx = device_fit_context(spec, num_langs, mesh)
     lang_arr = np.asarray(lang_indices, dtype=np.int32)
-    fixed_rows, byte_budget = resolve_fit_batching(batch_rows)
-    items, item_langs, plan, straddle = plan_fit_batches(
-        byte_docs, lang_arr, spec,
-        batch_rows=fixed_rows, byte_budget=byte_budget,
+    counts = accumulate_counts(
+        ctx, ctx.counts, byte_docs, lang_arr,
+        spec=spec, num_langs=num_langs, batch_rows=batch_rows,
+        extra_counts=extra_counts,
     )
-    # (rows, pad_to) -> dispatch count: exactly the compiled-shape set, so
-    # the roofline gauges below bill the loop's true cost (billing every
-    # step at the largest shape overstates small/tail steps by orders of
-    # magnitude).
-    step_shapes: dict[tuple[int, int], int] = {}
-    with span(
-        "fit/count", docs=len(byte_docs), backend="device", shards=ndata,
-        batches=len(plan),
-    ) as count_span:
-        from ..resilience import faults
-
-        # Pipelined ingest (ops.fit_pipeline): the packer thread keeps ≥2
-        # packed-and-transferring batches ahead of this loop; ragged
-        # transfer applies on single-device dispatch only (a mesh shards
-        # the padded batch itself — same rule as the scoring runner).
-        batches = iter_device_batches(
-            items, item_langs, plan,
-            placement=placement, ragged=mesh is None, ndata=ndata,
-            parent=count_span.parent,
-        )
-        try:
-            for batch, lengths, langs, rows, pad_to in batches:
-                faults.inject("fit/count")  # chaos: one call per count step
-                key = (rows, pad_to)
-                step_shapes[key] = step_shapes.get(key, 0) + 1
-                prev = counts
-                counts = step(
-                    batch, lengths, langs, counts,
-                    spec=spec, num_langs=num_langs,
-                )
-                if donate:
-                    note_donation_reuse(prev)
-        finally:
-            # Deterministic teardown: an injected/count-step failure stops
-            # the packer thread before the error leaves this frame, so the
-            # estimator-level replay starts from a clean slate.
-            batches.close()
-        # Count dispatch is async: fencing (opt-in) bills the span the
-        # device_s through the last batch's completion.
-        count_span.fence(counts)
-
-    # Boundary windows severed by oversized-doc chunk-splitting ride the
-    # same one-shot scatter as caller-provided extra counts (duplicate
-    # (id, lang) pairs accumulate — scatter-add semantics).
-    if straddle is not None:
-        if extra_counts is None:
-            extra_counts = straddle
-        else:
-            extra_counts = tuple(
-                np.concatenate(
-                    [np.asarray(a, dtype=np.int64), np.asarray(b, dtype=np.int64)]
-                )
-                for a, b in zip(extra_counts, straddle)
-            )
-
-    # Roofline gauges for the count loop (single-device only — the GSPMD
-    # program's cost model is per-process): summed per-shape program cost
-    # over the shapes the loop actually dispatched, in the same units as
-    # the fit/count span. Diagnostics; never fatal.
-    if mesh is None and step_shapes:
-        try:
-            from ..telemetry import cost as cost_mod
-
-            cost_mod.record_fit_count_cost(spec, num_langs, step_shapes)
-        except Exception:
-            pass
-
-    if extra_counts is not None:
-        e_ids, e_langs, e_counts = (
-            jnp.asarray(np.asarray(a, dtype=np.int32)) for a in extra_counts
-        )
-        if e_ids.size:
-            counts = counts.at[e_ids, e_langs].add(e_counts)
-
-    # Non-occurred rows are not candidates (the reference's table only holds
-    # grams seen in training); they mask below any real weight for top-k.
-    k = min(profile_size, V)
-    with span("fit/topk", backend="device", k=k, vocab=V) as topk_span:
-        if V * num_langs > TOPK_SORT_BUDGET_ELEMS:
-            # Big tables (config-3 scale): the scanned finalize never
-            # materializes the [V, L] weight table and bounds the top-k sort
-            # per vocab block; ties → lowest id either way.
-            top = finalize_topk_blocked(counts, weight_mode=weight_mode, k=k)
-        else:
-            masked = masked_candidate_weights(counts, weight_mode=weight_mode)
-            top = top_k_rows(masked, k=k)  # ties → lowest id (re-ranked)
-        topk_span.fence(top)
-
-    top_np = np.unique(np.asarray(top).reshape(-1))
-    top_np = top_np[top_np < V]  # blocked-path pad rows carry ids >= V
-    # Recompute winner weights on host in float64 from the exact integer
-    # counts (see docstring) instead of fetching the device's float32 table;
-    # the same gathered rows decide occurrence (non-occurred candidates
-    # surface only for languages with fewer than k real grams).
-    with span("fit/collect", winners=int(top_np.size)):
-        counts_sel = np.asarray(counts[jnp.asarray(top_np)], dtype=np.int64)
-        occurred_np = counts_sel.sum(axis=1) > 0
-        rows = top_np[occurred_np]  # dense row index == gram id
-        counts_rows = counts_sel[occurred_np]
-        if weight_mode == "parity":
-            present = counts_rows > 0
-            nlangs = present.sum(axis=1, keepdims=True)
-            ratio = np.where(present, 1.0 / np.maximum(nlangs, 1), 0.0)
-        else:
-            totals = counts_rows.sum(axis=1, keepdims=True)
-            ratio = counts_rows / np.maximum(totals, 1)
-        weights = np.log1p(ratio.astype(np.float64))
-    return rows.astype(np.int64), weights
+    return finalize_counts(
+        counts,
+        num_langs=num_langs,
+        profile_size=profile_size,
+        weight_mode=weight_mode,
+        mesh=mesh,
+        table_sharded=ctx.table_sharded,
+    )
 
 
 def fit_profile_device_split(

@@ -88,6 +88,27 @@ def vocab_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(VOCAB_AXIS))
 
 
+def table_axis(mesh: Mesh) -> str:
+    """The mesh axis that shards [V, L] count/weight tables for the fit.
+
+    A dedicated vocab axis wins when it actually has devices; otherwise the
+    data axis doubles as the table axis — the fit mesh is usually built
+    data-only (``resolve_fit_mesh``), and sharding the count accumulator
+    over its devices is what turns the per-step count reduction into a
+    reduce-scatter and bounds every device's finalize to V/ndata rows.
+    """
+    return VOCAB_AXIS if int(mesh.shape[VOCAB_AXIS]) > 1 else DATA_AXIS
+
+
+def table_sharding(mesh: Mesh) -> NamedSharding:
+    """[V, L] tables split over :func:`table_axis` (rows)."""
+    return NamedSharding(mesh, P(table_axis(mesh)))
+
+
+def table_shards(mesh: Mesh) -> int:
+    return int(mesh.shape[table_axis(mesh)])
+
+
 def pad_to_multiple(n: int, k: int) -> int:
     return -(-n // k) * k
 

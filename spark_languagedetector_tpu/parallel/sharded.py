@@ -22,7 +22,7 @@ LanguageDetector.scala:52-132) with exactly one collective.
 from __future__ import annotations
 
 import itertools
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -33,7 +33,16 @@ from ..ops.score import score_batch
 from ..ops.vocab import VocabSpec
 from ..resilience import faults
 from ..telemetry import span
-from .mesh import DATA_AXIS, VOCAB_AXIS, batch_sharding, replicated, vocab_sharding
+from .mesh import (
+    DATA_AXIS,
+    batch_sharding,
+    replicated,
+    shard_map_compat,
+    table_axis,
+    table_sharding,
+    table_shards,
+    vocab_sharding,
+)
 
 
 def make_sharded_scorer(
@@ -98,15 +107,21 @@ def make_sharded_fit_step(
     spec: VocabSpec,
     num_langs: int,
     *,
-    shard_vocab: bool = True,
+    shard_vocab: bool | None = None,
+    shard_table: bool | None = None,
     donate: bool | None = None,
 ):
     """jit-compiled distributed fit accumulation step.
 
     ``fn(batch [B,S], lengths [B], lang_ids [B], counts_acc [V,L])
     -> counts_acc'`` — batch sharded over ``data``, the accumulator sharded
-    over ``vocab`` (or replicated). The cross-device count reduction is the
-    collective GSPMD derives from the output sharding.
+    over the TABLE axis (or replicated). The table axis
+    (``mesh.table_axis``) is the vocab axis when it has devices, else the
+    data axis — so a data-only fit mesh still stripes the accumulator, and
+    the cross-device count reduction GSPMD derives from the output
+    sharding becomes a reduce-scatter instead of a full-table all-reduce.
+    ``shard_vocab`` is the historical name for the same switch; both
+    accept None (→ shard) and ``shard_table`` wins when both are given.
 
     ``donate``: donate the accumulator buffer so XLA updates the [V, L]
     table in place instead of double-buffering it per step (the table is
@@ -117,7 +132,9 @@ def make_sharded_fit_step(
     not reuse a passed accumulator after the call (the ``acc = step(acc)``
     chain every existing caller follows).
     """
-    acc_sharding = vocab_sharding(mesh) if shard_vocab else replicated(mesh)
+    if shard_table is None:
+        shard_table = True if shard_vocab is None else shard_vocab
+    acc_sharding = table_sharding(mesh) if shard_table else replicated(mesh)
     if donate is None:
         donate = mesh.devices.flat[0].platform != "cpu"
 
@@ -171,9 +188,12 @@ def make_sharded_finalize(
     top-k row ids [L,k]) with the table sharded over ``vocab``.
 
     ``lax.top_k`` over a vocab-sharded column is handled by GSPMD as
-    local top-k + cross-shard merge.
+    local top-k + cross-shard merge. This is the legacy full-table
+    finalize (it materializes and RETURNS the [V, L] weight table); the
+    fit path's winner-rows-only finalize is
+    :func:`make_sharded_finalize_topk`.
     """
-    acc_sharding = vocab_sharding(mesh) if shard_vocab else replicated(mesh)
+    acc_sharding = table_sharding(mesh) if shard_vocab else replicated(mesh)
 
     @partial(
         jax.jit,
@@ -186,7 +206,7 @@ def make_sharded_finalize(
         top_rows = fit_tpu.top_k_rows(weights, k=k)
         return weights, top_rows
 
-    nshards = int(mesh.shape[VOCAB_AXIS] if shard_vocab else 1)
+    nshards = table_shards(mesh) if shard_vocab else 1
 
     def timed_finalize(counts):
         # No k passthrough: pjit raises "does not support kwargs when
@@ -198,6 +218,89 @@ def make_sharded_finalize(
         return weights, top_rows
 
     return timed_finalize
+
+
+@lru_cache(maxsize=16)
+def make_sharded_finalize_topk(
+    mesh: Mesh,
+    *,
+    profile_size: int,
+    weight_mode: str = "parity",
+    block: int = 1 << 21,
+):
+    """Distributed reduce half of the fit: table-sharded counts [V, L] →
+    replicated per-language top-k row ids [L, k], entirely on device.
+
+    DrJAX (arXiv:2403.07128) frames the fit as map(count)/reduce(top-k);
+    this is the reduce as one explicit shard_map program over the mesh's
+    table axis:
+
+      1. every shard computes its stripe's masked candidate weights and its
+         local top-k candidates under the (value desc, id asc) total order,
+         with ids lifted to GLOBAL gram ids
+         (``ops.fit_tpu.shard_topk_candidates`` — blocked within the shard
+         when the stripe exceeds the sort budget);
+      2. an ``all_gather`` over the table axis concatenates every shard's
+         ``k`` candidate (value, id) pairs — the only collective, moving
+         ``shards·k·L`` pairs instead of the ``V·L`` table;
+      3. the final selection re-ranks the boundary plateau by the
+         candidates' real ids (``_final_candidates_top_k``), so the merge
+         preserves the host fit's lowest-index tie order exactly, for any
+         shard geometry.
+
+    Exactness is the :func:`ops.fit_tpu.top_k_rows_blocked` argument with
+    blocks = shards. Requires V divisible by the table-axis size (shard_map
+    needs even stripes); callers fall back to the unsharded finalize
+    otherwise (``ops.fit_tpu.finalize_counts``).
+
+    Memoized on (mesh, k, weight_mode, block): the incremental refit
+    engine re-runs ONLY this program per refit, so rebuilding the
+    shard_map closure (and thus recompiling) every time would make refits
+    pay a compile each — the cache keeps a live mesh's program warm.
+    """
+    from ..ops.fit_tpu import (
+        _final_candidates_top_k,
+        masked_candidate_weights,
+        shard_topk_candidates,
+    )
+
+    ax = table_axis(mesh)
+    nshards = table_shards(mesh)
+
+    def local_topk(counts_shard):  # [V/shards, L] stripe
+        rows = counts_shard.shape[0]
+        kk = min(profile_size, rows)
+        offset = (jax.lax.axis_index(ax) * rows).astype(jnp.int32)
+        masked = masked_candidate_weights(
+            counts_shard, weight_mode=weight_mode
+        )
+        bv, bi = shard_topk_candidates(masked, kk, offset, block=block)
+        cand_v = jax.lax.all_gather(bv, ax, axis=1, tiled=True)
+        cand_i = jax.lax.all_gather(bi, ax, axis=1, tiled=True)
+        return _final_candidates_top_k(
+            cand_v, cand_i, min(profile_size, rows * nshards)
+        )
+
+    # check_vma off: every shard computes the same merged result from the
+    # all_gathered candidates; the rep-checker can't see that through the
+    # top_k re-ranking.
+    fn = jax.jit(
+        shard_map_compat(
+            local_topk,
+            mesh=mesh,
+            in_specs=(P(ax),),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+
+    def timed_topk(counts):
+        with span("shard_finalize_topk", shards=nshards) as sp:
+            top = fn(counts)
+            sp.fence(top)
+        return top
+
+    return timed_topk
 
 
 def training_step(

@@ -335,6 +335,231 @@ def load_model(path: str | Path) -> tuple[GramProfile, str, dict]:
     return profile, meta["uid"], params
 
 
+_FIT_STATE_CLASS = "spark_languagedetector_tpu.models.refit.FitAccumulator"
+FIT_STATE_VERSION = 1
+
+
+def save_fit_state(
+    path: str | Path,
+    *,
+    spec: VocabSpec,
+    languages,
+    weight_mode: str,
+    profile_size: int,
+    train_encoding: str,
+    label_col: str,
+    input_col: str,
+    batch_rows: int | None,
+    committed: int,
+    docs_seen: int,
+    lang_docs,
+    ids: np.ndarray,
+    rows: np.ndarray,
+) -> None:
+    """Persist an incremental-fit count accumulator (the fit's sufficient
+    statistic) as a checkpoint directory.
+
+    Layout mirrors the model codec: ``metadata/part-00000`` one JSON line
+    (spec, languages, weight mode, profile size, per-language doc coverage,
+    and the RESUME TOKEN ``committed`` — the number of source batches whose
+    counts this table already contains), plus ``counts/`` parquet of the
+    NONZERO table rows (``id`` int64, ``counts`` list<int64> per language).
+    Sparse row storage: a 2^20×176 table with a few hundred thousand
+    occurring grams stores those rows, not the 738MB dense form.
+
+    The write is crash-atomic the same way ``api.pipeline`` saves are: the
+    whole tree is built under a temp sibling and swapped in with renames,
+    so a process killed mid-checkpoint leaves either the previous
+    accumulator state or the new one — never a torn directory. The token
+    travels INSIDE the state (not a side file), so counts and token can
+    never commit separately: a resumed stream replays exactly the batches
+    the table does not contain (docs/SERVING.md §7).
+    """
+    import os
+
+    import pyarrow as pa
+
+    root = Path(path)
+    meta = {
+        "class": _FIT_STATE_CLASS,
+        "version": FIT_STATE_VERSION,
+        "timestamp": int(time.time() * 1000),
+        "vocab": {
+            "mode": spec.mode,
+            "gramLengths": list(spec.gram_lengths),
+            "hashBits": spec.hash_bits,
+            "hashScheme": spec.hash_scheme,
+        },
+        "languages": list(languages),
+        "weightMode": weight_mode,
+        "profileSize": int(profile_size),
+        # Part of the statistic, not plumbing: the same corpus under a
+        # different text→bytes encoding counts different grams, so a
+        # resumed accumulator must keep the encoding its counts were
+        # built under.
+        "trainEncoding": train_encoding,
+        # Plumbing that must survive a restart all the same: a restored
+        # accumulator keeps reading the columns (and micro-batch rows)
+        # its updates were configured with.
+        "labelCol": label_col,
+        "inputCol": input_col,
+        "fitBatchRows": batch_rows,
+        "committed": int(committed),
+        "docsSeen": int(docs_seen),
+        "langDocs": [int(c) for c in lang_docs],
+    }
+    tmp = root.parent / f".{root.name}.tmp.{os.getpid()}"
+    if tmp.exists():
+        shutil.rmtree(tmp)
+    tmp.mkdir(parents=True)
+    try:
+        meta_dir = tmp / "metadata"
+        meta_dir.mkdir()
+        (meta_dir / "part-00000").write_text(json.dumps(meta) + "\n")
+        # Numpy-native arrow columns: this codec runs once per STREAMED
+        # batch (the auto-refit driver checkpoints after every consumed
+        # batch), and round-tripping a few-hundred-thousand-row × L table
+        # through Python lists would dominate the per-batch commit. The
+        # flat values zero-copy; offsets are a cheap arange.
+        ids_np = np.ascontiguousarray(np.asarray(ids, dtype=np.int64))
+        rows_np = np.ascontiguousarray(np.asarray(rows, dtype=np.int64))
+        n, L = rows_np.shape
+        offsets = pa.array(np.arange(0, (n + 1) * L, L, dtype=np.int32))
+        counts_col = pa.ListArray.from_arrays(
+            offsets, pa.array(rows_np.reshape(-1))
+        )
+        _write_parquet(
+            tmp / "counts",
+            pa.table({"id": pa.array(ids_np), "counts": counts_col}),
+        )
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    backup = None
+    if root.exists():
+        backup = root.parent / f".{root.name}.old.{os.getpid()}"
+        if backup.exists():
+            shutil.rmtree(backup)
+        os.replace(root, backup)
+    try:
+        os.replace(tmp, root)
+    except BaseException:
+        if backup is not None:
+            os.replace(backup, root)
+        raise
+    if backup is not None:
+        shutil.rmtree(backup)
+    # A crashed EARLIER run (different pid) may have left .tmp/.old
+    # siblings behind; with a good state now at root they are garbage —
+    # clean them so crashed runs don't leak checkpoint-sized trees.
+    for stale in list(root.parent.glob(f".{root.name}.tmp.*")) + list(
+        root.parent.glob(f".{root.name}.old.*")
+    ):
+        shutil.rmtree(stale, ignore_errors=True)
+    log_event(
+        _log, "fit_state.saved", path=str(root), committed=int(committed),
+        nonzero_rows=int(len(ids)),
+    )
+
+
+def recover_fit_state(path: str | Path) -> bool:
+    """Finish a checkpoint swap a crash interrupted; True when recovered.
+
+    The save's two-rename swap has one unavoidable window (POSIX has no
+    directory exchange): killed between "root renamed aside" and "tmp
+    renamed in", the path holds NO state — the data lives complete in a
+    ``.<name>.tmp.<pid>`` (new) or ``.<name>.old.<pid>`` (previous)
+    sibling. When ``path`` is missing, this promotes the newest candidate
+    (by mtime) that FULLY loads — a SIGKILL mid-build can leave a torn
+    tmp whose metadata parses but whose counts parquet is missing or
+    truncated, so a metadata check alone would promote garbage; full
+    validation (:func:`load_fit_state`) is the guard. Other siblings are
+    deleted only AFTER a candidate was successfully promoted, so a torn
+    candidate can never cost a complete one. Call before checking
+    existence of a resumable state (the auto-refit driver does). No-op
+    when ``path`` exists.
+    """
+    import os
+
+    root = Path(path)
+    if root.exists():
+        return False
+    candidates = list(root.parent.glob(f".{root.name}.tmp.*")) + list(
+        root.parent.glob(f".{root.name}.old.*")
+    )
+    candidates.sort(key=lambda p: p.stat().st_mtime, reverse=True)
+    for cand in candidates:
+        try:
+            state = load_fit_state(cand)  # full validation, counts included
+        except Exception:
+            continue  # torn/foreign candidate: never promote it
+        os.replace(cand, root)
+        for stale in list(root.parent.glob(f".{root.name}.tmp.*")) + list(
+            root.parent.glob(f".{root.name}.old.*")
+        ):
+            shutil.rmtree(stale, ignore_errors=True)
+        log_event(
+            _log, "fit_state.recovered", path=str(root), source=cand.name,
+            committed=state["committed"],
+        )
+        return True
+    return False
+
+
+def load_fit_state(path: str | Path) -> dict:
+    """Read a persisted fit accumulator → dict with the metadata fields of
+    :func:`save_fit_state` plus ``spec`` (a reconstructed VocabSpec),
+    ``ids`` (int64 [R]) and ``rows`` (int64 [R, L]) sparse count rows."""
+    root = Path(path)
+    meta = json.loads(
+        (root / "metadata" / "part-00000").read_text().splitlines()[0]
+    )
+    if meta.get("class") != _FIT_STATE_CLASS:
+        raise ValueError(
+            f"metadata class mismatch: expected {_FIT_STATE_CLASS}, got "
+            f"{meta.get('class')}"
+        )
+    vocab = meta["vocab"]
+    spec = VocabSpec(
+        vocab["mode"],
+        tuple(int(n) for n in vocab["gramLengths"]),
+        hash_bits=vocab.get("hashBits", 20),
+        hash_scheme=vocab.get("hashScheme", "fnv1a"),
+    )
+    table = _read_parquet(root / "counts")
+    L = len(meta["languages"])
+    ids = table["id"].combine_chunks().to_numpy(
+        zero_copy_only=False
+    ).astype(np.int64, copy=False)
+    counts_col = table["counts"].combine_chunks()
+    flat = counts_col.flatten().to_numpy(zero_copy_only=False)
+    if len(flat) != len(ids) * L:
+        raise ValueError(
+            f"count rows carry {len(flat)} values for {len(ids)} grams, "
+            f"metadata says {L} languages"
+        )
+    rows = (
+        flat.astype(np.int64, copy=False).reshape(len(ids), L)
+        if len(ids)
+        else np.zeros((0, L), dtype=np.int64)
+    )
+    return {
+        "spec": spec,
+        "languages": tuple(meta["languages"]),
+        "weight_mode": meta["weightMode"],
+        "profile_size": int(meta["profileSize"]),
+        "train_encoding": meta.get("trainEncoding", "utf8"),
+        "label_col": meta.get("labelCol", "lang"),
+        "input_col": meta.get("inputCol", "fulltext"),
+        "batch_rows": meta.get("fitBatchRows"),
+        "committed": int(meta["committed"]),
+        "docs_seen": int(meta["docsSeen"]),
+        "lang_docs": [int(c) for c in meta["langDocs"]],
+        "ids": ids,
+        "rows": rows,
+    }
+
+
 def save_gram_dump(path: str | Path, profile: GramProfile) -> None:
     """The reference's ``saveGramsToHDFS`` artifact
     (LanguageDetector.scala:167-171): the fitted gram-probability dataset as

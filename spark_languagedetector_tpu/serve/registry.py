@@ -41,10 +41,10 @@ class ModelVersion:
 
     __slots__ = (
         "version", "model", "runner", "languages", "source",
-        "installed_at", "inflight", "retired",
+        "installed_at", "inflight", "retired", "metadata",
     )
 
-    def __init__(self, version, model, runner, source):
+    def __init__(self, version, model, runner, source, metadata=None):
         self.version = version
         self.model = model
         self.runner = runner
@@ -53,13 +53,14 @@ class ModelVersion:
         self.installed_at = time.time()
         self.inflight = 0
         self.retired = False
+        self.metadata = dict(metadata) if metadata else None
 
     def describe(self) -> dict:
         try:
             quant = self.model.get_or_default("quantization")
         except Exception:
             quant = None
-        return {
+        out = {
             "version": self.version,
             "uid": self.model.uid,
             "languages": len(self.languages),
@@ -71,6 +72,9 @@ class ModelVersion:
             "inflight": self.inflight,
             "retired": self.retired,
         }
+        if self.metadata:
+            out["metadata"] = dict(self.metadata)
+        return out
 
 
 class ModelRegistry:
@@ -104,6 +108,7 @@ class ModelRegistry:
         version: str | None = None,
         prewarm: bool = True,
         source: str | None = None,
+        metadata: dict | None = None,
     ) -> str:
         """Register ``model`` and atomically make it the serving version.
 
@@ -112,6 +117,11 @@ class ModelRegistry:
         first; only then does the serving pointer flip. The previously
         active version is drained (bounded by ``drain_timeout_s``) and
         retired — but kept in history for :meth:`rollback`.
+
+        ``metadata``: optional provenance dict surfaced by ``describe()``/
+        ``versions()`` (and thus ``/varz``) — the auto-refit driver stamps
+        its refit token and doc coverage here so an operator can tell WHICH
+        accumulated corpus a serving version was finalized from.
         """
         runner = model._get_runner()
         if prewarm and self._prewarm_docs:
@@ -129,7 +139,7 @@ class ModelRegistry:
                 version = f"v{self._counter}"
             if any(e.version == version for e in self._history):
                 raise ServeError(f"version {version!r} already registered")
-            entry = ModelVersion(version, model, runner, source)
+            entry = ModelVersion(version, model, runner, source, metadata)
             old = (
                 None if self._active_idx is None
                 else self._history[self._active_idx]

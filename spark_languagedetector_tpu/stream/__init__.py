@@ -1,1 +1,4 @@
-"""stream subpackage."""
+"""stream subpackage: micro-batch scoring (:mod:`.microbatch`) and the
+continuous-learning auto-refit driver (:mod:`.refit`)."""
+
+from .refit import AutoRefit, RefitProgress  # noqa: F401

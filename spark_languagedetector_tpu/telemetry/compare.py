@@ -40,12 +40,17 @@ DEFAULT_METRICS = ("p50", "p90", "p99", "device_total_s", "device_p99")
 # seconds, latency, padding waste, retries) regresses upward.
 _HIGHER_BETTER = ("fill_ratio",)
 
-# Tracked gauges (last snapshot): table-traffic contract metrics. A change
-# that silently de-quantizes a profile (table_bytes jumps 4x) or
-# re-balloons a program's memory traffic (est_bytes_utilization climbs
-# back toward the HBM roof) regresses here even when every latency
-# percentile held steady — docs/PERFORMANCE.md §7.
-_TRACKED_GAUGES = ("langdetect_table_bytes",)
+# Tracked gauges (last snapshot): byte-traffic contract metrics, keyed to
+# a short stable name. A change that silently de-quantizes a profile
+# (table_bytes jumps 4x), re-balloons a program's memory traffic
+# (est_bytes_utilization climbs back toward the HBM roof), or falls back
+# to a full-[V,L]-table fit collect (fit_collect_bytes jumps from k·L
+# winner rows to the whole table — docs/PERFORMANCE.md §8) regresses here
+# even when every latency percentile held steady.
+_TRACKED_GAUGES = {
+    "langdetect_table_bytes": "table_bytes",
+    "langdetect_fit_collect_bytes": "fit_collect_bytes",
+}
 
 
 def _tracked_metrics(events: list[dict], stages: dict) -> dict[str, float]:
@@ -66,7 +71,7 @@ def _tracked_metrics(events: list[dict], stages: dict) -> dict[str, float]:
         if isinstance(payload, dict):
             gauges = payload
     out: dict[str, float] = {}
-    for name in _TRACKED_GAUGES:
+    for name, short in _TRACKED_GAUGES.items():
         series = gauges.get(name)
         if not isinstance(series, dict):
             continue
@@ -81,7 +86,7 @@ def _tracked_metrics(events: list[dict], stages: dict) -> dict[str, float]:
             program = dict(
                 p.split("=", 1) for p in label.split(",") if "=" in p
             ).get("program", label)
-            key = f"table_bytes[{program}]"
+            key = f"{short}[{program}]"
             out[key] = max(out.get(key, 0.0), float(val))
     peak = None
     for label, val in (gauges.get("device_peak_bytes_per_s") or {}).items():

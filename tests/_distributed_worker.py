@@ -1,6 +1,7 @@
 """Worker for the two-process jax.distributed test (test_resilience.py).
 
 Run as: python tests/_distributed_worker.py <coordinator> <n_procs> <pid>
+        python tests/_distributed_worker.py <coordinator> <n_procs> <pid> --probe
 
 Each process pins JAX to CPU with two virtual devices, joins the
 coordination service through the framework's own ``parallel.distributed``
@@ -8,6 +9,17 @@ entry points, then runs a real cross-process computation: host-sharded
 rows assembled into one globally-sharded array, reduced under jit (XLA
 inserts the cross-process collective), verified against the full-data
 answer on every process.
+
+``--probe`` runs ONLY the capability probe: distributed bring-up plus one
+jit reduction over a cross-process array, built EXCLUSIVELY from jax
+public APIs — it imports nothing from this framework, so a probe failure
+can only indicate the substrate (jaxlib, coordination service, process
+spawning), never a framework regression. Some jaxlib builds cannot
+execute multi-process computations on the CPU backend at all
+("Multiprocess computations aren't implemented on the CPU backend"); the
+probe lets the test skip those hosts with the real reason instead of
+failing, and the full worker runs only once the probe proved the
+substrate works.
 """
 import os
 import sys
@@ -28,10 +40,43 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 
 
+def probe(coordinator: str, n_procs: int, pid: int) -> None:
+    """Capability probe: PURE jax/jaxlib surface only — distributed
+    bring-up, a globally-sharded array assembled with
+    ``jax.make_array_from_single_device_arrays``, and one jit reduction
+    crossing processes. Deliberately imports nothing from this framework:
+    a probe failure can only mean the substrate (jaxlib/coordination
+    service/process spawning) cannot do two-process CPU collectives, never
+    that framework code regressed."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=n_procs,
+        process_id=pid,
+    )
+    assert jax.process_count() == n_procs, jax.process_count()
+    mesh = Mesh(np.asarray(jax.devices()).reshape(-1, 1), ("data", "vocab"))
+    sharding = NamedSharding(mesh, P("data"))
+    rows, cols = 4 * n_procs, 3
+    full = np.arange(rows * cols, dtype=np.float32).reshape(rows, cols)
+    imap = sharding.addressable_devices_indices_map((rows, cols))
+    garr = jax.make_array_from_single_device_arrays(
+        (rows, cols), sharding,
+        [jax.device_put(full[idx], d) for d, idx in imap.items()],
+    )
+    total = float(jax.jit(lambda x: x.sum())(garr))
+    assert total == float(full.sum()), (total, float(full.sum()))
+    print(f"DIST_PROBE_OK pid={pid}", flush=True)
+
+
 def main() -> None:
     coordinator, n_procs, pid = (
         sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
     )
+    if "--probe" in sys.argv[4:]:
+        probe(coordinator, n_procs, pid)
+        return
     from spark_languagedetector_tpu.parallel import distributed as D
     from spark_languagedetector_tpu.parallel.mesh import (
         batch_sharding,

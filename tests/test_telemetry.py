@@ -716,8 +716,13 @@ def test_fit_records_stage_spans_host_and_device():
     ids_d, w_d = fit_profile_device(docs, langs, 2, spec, 50)
     np.testing.assert_array_equal(ids_h, ids_d)
     stages = REGISTRY.stage_summary()
-    for path in ("fit/count", "fit/topk", "fit/collect"):
+    # The device reduce half records fit/finalize (on-device weighting +
+    # top-k) and fit/collect (winner-rows-only fetch, with its byte gauge).
+    for path in ("fit/count", "fit/finalize", "fit/collect"):
         assert path in stages, stages
+    snap = REGISTRY.snapshot()
+    assert snap["counters"].get("fit/collect_bytes", 0) > 0
+    assert "langdetect_fit_collect_bytes" in snap["gauges"]
 
 
 def test_split_fit_records_host_half_and_merge():
@@ -1400,6 +1405,51 @@ def test_compare_tracked_bytes_utilization(tmp_path, capsys):
     capsys.readouterr()
     assert c_main([str(plain), str(a)]) == 0
     assert "only in candidate" in capsys.readouterr().out
+
+
+def _collect_capture(path, collect_bytes):
+    """Synthetic capture: a fit with the winner-rows collect gauge."""
+    events = [
+        {
+            "event": "telemetry.span", "ts": 1.0, "path": "fit/collect",
+            "wall_s": 0.002,
+        },
+        {
+            "event": "telemetry.snapshot", "ts": 2.0, "counters": {},
+            "histograms": {},
+            "gauges": {
+                "langdetect_fit_collect_bytes": {
+                    "program=fit/collect": collect_bytes,
+                },
+            },
+        },
+    ]
+    path.write_text("".join(json.dumps(ev) + "\n" for ev in events))
+
+
+def test_compare_tracked_fit_collect_bytes_regression(tmp_path, capsys):
+    """The fit-collect contract (docs/PERFORMANCE.md §8): a change that
+    silently falls back to pulling the full [V, L] table instead of the
+    k·L winner rows balloons langdetect_fit_collect_bytes and must fail
+    the guard even with every latency percentile steady."""
+    from spark_languagedetector_tpu.telemetry.compare import (
+        capture_stats,
+        main as c_main,
+    )
+    from spark_languagedetector_tpu.telemetry.report import load_events
+
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    _collect_capture(a, 57_000.0)  # winner rows (k=400 × 6 langs × 4B + ids)
+    _collect_capture(b, 1_572_864.0)  # full 2^16 × 6 table came back
+    stats = capture_stats(load_events(str(a)))
+    assert stats["tracked"]["fit_collect_bytes[fit/collect]"] == 57_000.0
+    assert c_main([str(a), str(b)]) == 1
+    assert "fit_collect_bytes[fit/collect]" in capsys.readouterr().out
+    capsys.readouterr()
+    assert c_main([str(a), str(a)]) == 0  # identical captures pass
+    # Shrinking the collect (more aggressive winners) never flags.
+    capsys.readouterr()
+    assert c_main([str(b), str(a)]) == 0
 
 
 def test_compare_cli_usage_and_io_errors(tmp_path, capsys):

@@ -858,7 +858,7 @@ def fused_leg(model, cfg, langs, base_pred, sub, cpp_mt_dps, eval_docs):
                 min(longest, runner.max_chunk) or 1, runner.length_buckets
             )
             rows = min(len(parity_docs), rows_for_bucket(
-                pad_to, runner.batch_size
+                pad_to, runner.batch_size, runner.batch_bytes
             ))
             reg = Registry()
             cost = cost_mod.record_runner_cost(runner, rows, pad_to, reg)
@@ -960,7 +960,16 @@ def telemetry_block(jsonl_path: str) -> dict:
 
     sample_device_gauges()
     REGISTRY.flush()
-    return {"jsonl": jsonl_path, "stages": REGISTRY.stage_summary()}
+    from spark_languagedetector_tpu.exec import config as exec_config
+
+    return {
+        "jsonl": jsonl_path,
+        "stages": REGISTRY.stage_summary(),
+        # The audited effective config (same block /varz serves): every
+        # knob's live value + provenance, so a bench artifact records
+        # exactly which lattice/budget/window produced its numbers.
+        "effective_config": exec_config.effective_config(),
+    }
 
 
 def smoke_telemetry(jsonl_path: str | None = None) -> dict:
@@ -1589,6 +1598,152 @@ def smoke_refit(jsonl_path: str | None = None) -> dict:
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def smoke_tune(jsonl_path: str | None = None) -> dict:
+    """CPU-safe autotuner smoke: capture → ``exec.tune`` → re-run tuned.
+
+    The full measured-defaults loop on a config-1-shaped model (bigram
+    exact vocab, 3 languages): pass A scores a corpus whose length
+    distribution deliberately misaligns with the default bucket lattice
+    (the everyday padding tax) under untuned defaults with a JSONL
+    capture; the autotuner replays the capture and emits a versioned
+    tuning profile; pass B points ``LANGDETECT_TUNING_PROFILE`` at it and
+    re-scores the same docs on a freshly-constructed runner — the real
+    startup-load path, no special plumbing.
+
+    Hard gates (``main()`` exits nonzero): aggregate padding waste
+    (1 − real/capacity wire bytes, the exact whole-run counters) strictly
+    lower under the tuned profile, argmax parity exactly 1.0 vs the
+    untuned pass (gather strategy — batch-geometry-stable, so scores are
+    bit-identical across lattices by construction and any parity miss is
+    a real bug), and the tuned lattice within the default compile-shape
+    budget. Seconds, no accelerator.
+    """
+    import tempfile
+
+    from spark_languagedetector_tpu import LanguageDetector, Table
+    from spark_languagedetector_tpu.api.runner import BatchRunner
+    from spark_languagedetector_tpu.exec import config as exec_config
+    from spark_languagedetector_tpu.exec import tune as exec_tune
+    from spark_languagedetector_tpu.ops.encoding import (
+        DEFAULT_LENGTH_BUCKETS,
+        texts_to_bytes,
+    )
+    from spark_languagedetector_tpu.telemetry import REGISTRY
+    from spark_languagedetector_tpu.telemetry.export import JsonlSink
+    from spark_languagedetector_tpu.telemetry.report import load_events
+
+    langs = language_names(3)
+    train_docs, train_labels = make_corpus(langs, 90, mean_len=200, seed=3)
+    model = LanguageDetector(langs, [2], 2000).fit(
+        Table({"lang": train_labels, "fulltext": train_docs})
+    )
+    # Eval lengths clustered just past bucket edges: the bulk lands in
+    # (256, 512] (padded to 512 at ~0.6 fill) with a short-doc minority in
+    # (64, 128] — the distribution shape the DP solver exists for.
+    docs_a, _ = make_corpus(langs, 600, seed=5, len_range=(260, 380))
+    docs_b, _ = make_corpus(langs, 200, seed=7, len_range=(80, 120))
+    eval_docs = texts_to_bytes(docs_a + docs_b)
+
+    weights, lut, cuckoo = model.profile.device_membership()
+
+    def build_runner() -> BatchRunner:
+        # gather = the geometry-stable A/B reference; padded transfers
+        # (no ragged) so the padded lattice is what the gate measures.
+        return BatchRunner(
+            weights=weights, lut=lut, cuckoo=cuckoo,
+            spec=model.profile.spec, strategy="gather",
+            ragged_transfer=False,
+        )
+
+    def one_pass(sink_path: str) -> tuple:
+        sink = JsonlSink(sink_path)
+        REGISTRY.reset()
+        REGISTRY.add_sink(sink)
+        try:
+            runner = build_runner()
+            ids = runner.predict_ids(eval_docs)
+            REGISTRY.flush()  # snapshot (exec/len + wire counters) → jsonl
+            snap = REGISTRY.snapshot()
+            real = snap["counters"].get("score/real_bytes", 0)
+            cap = snap["counters"].get("score/capacity_bytes", 0)
+            waste = 1.0 - real / cap if cap else 0.0
+            return ids, waste, tuple(runner.length_buckets)
+        finally:
+            REGISTRY.remove_sink(sink)
+
+    path_a = jsonl_path or os.path.join(
+        tempfile.gettempdir(), f"tune_smoke_{os.getpid()}.jsonl"
+    )
+    path_b = path_a + ".tuned.jsonl"
+    profile_path = os.path.join(
+        tempfile.gettempdir(), f"tune_smoke_profile_{os.getpid()}.json"
+    )
+
+    ids_untuned, waste_untuned, buckets_untuned = one_pass(path_a)
+    profile = exec_tune.solve(
+        load_events(path_a), max_shapes=len(DEFAULT_LENGTH_BUCKETS)
+    )
+    profile.save(profile_path)
+
+    prev_env = os.environ.get(exec_config.PROFILE_ENV)
+    os.environ[exec_config.PROFILE_ENV] = profile_path
+    exec_config.reload_profile()
+    try:
+        ids_tuned, waste_tuned, buckets_tuned = one_pass(path_b)
+    finally:
+        if prev_env is None:
+            os.environ.pop(exec_config.PROFILE_ENV, None)
+        else:
+            os.environ[exec_config.PROFILE_ENV] = prev_env
+        exec_config.reload_profile()
+
+    parity = float(np.mean(ids_untuned == ids_tuned))
+    errors = []
+    if waste_tuned >= waste_untuned:
+        errors.append(
+            f"padding_waste not reduced: {waste_untuned:.4f} -> "
+            f"{waste_tuned:.4f}"
+        )
+    if parity != 1.0:
+        errors.append(f"argmax parity {parity:.6f} != 1.0")
+    if len(buckets_tuned) > len(DEFAULT_LENGTH_BUCKETS):
+        errors.append(
+            f"tuned lattice exceeds compile-shape budget: "
+            f"{len(buckets_tuned)} > {len(DEFAULT_LENGTH_BUCKETS)}"
+        )
+    result = {
+        "smoke_tune": True,
+        "docs": len(eval_docs),
+        "padding_waste": {
+            "untuned": round(waste_untuned, 6),
+            "tuned": round(waste_tuned, 6),
+            "reduction": round(
+                (waste_untuned - waste_tuned) / waste_untuned, 6
+            ) if waste_untuned else 0.0,
+        },
+        "argmax_parity": parity,
+        "lattice": {
+            "untuned": list(buckets_untuned),
+            "tuned": list(buckets_tuned),
+        },
+        "profile": {
+            "version": profile.version,
+            "path": profile_path,
+            "tuned": {
+                k: (list(v) if isinstance(v, tuple) else v)
+                for k, v in profile.tuned.items()
+            },
+            "predicted_padded_reduction": profile.source[
+                "predicted_padded_reduction"
+            ],
+        },
+        "errors": errors[:5],
+        "telemetry": {"untuned_jsonl": path_a, "tuned_jsonl": path_b},
+    }
+    result["ok"] = not errors
+    return result
+
+
 def fit_scaling_probe(n_devices: int) -> dict:
     """Child half of the fit-scaling leg: run in a subprocess whose
     XLA_FLAGS forced ``n_devices`` virtual CPU devices. Fits the probe
@@ -1905,7 +2060,7 @@ def measure_compute_only(model, eval_docs):
     # length bucket.
     from spark_languagedetector_tpu.api.runner import rows_for_bucket
 
-    rows = rows_for_bucket(pad_to, runner.batch_size)
+    rows = rows_for_bucket(pad_to, runner.batch_size, runner.batch_bytes)
     while len(docs_b) < rows:  # tile short corpora up to production size
         docs_b = docs_b + docs_b
     docs_b = [d[:pad_to] for d in docs_b[:rows]]
@@ -2401,6 +2556,29 @@ def main():
         if not result["ok"]:
             print(
                 "refit smoke FAILED: "
+                + ("; ".join(result["errors"]) or "gate not met"),
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        return
+    if "--smoke-tune" in sys.argv[1:]:
+        # Autotuner smoke path: untuned capture → exec.tune → tuned re-run.
+        # Gates: strictly lower aggregate padding waste, argmax parity 1.0,
+        # tuned lattice within the compile-shape budget.
+        args = [a for a in sys.argv[1:] if a != "--smoke-tune"]
+        flags = [a for a in args if a.startswith("-")]
+        if flags or len(args) > 1:
+            print(
+                f"usage: python bench.py --smoke-tune [out.jsonl] "
+                f"(got {args})",
+                file=sys.stderr,
+            )
+            sys.exit(2)
+        result = smoke_tune(args[0] if args else None)
+        print(json.dumps(result), flush=True)
+        if not result["ok"]:
+            print(
+                "tune smoke FAILED: "
                 + ("; ".join(result["errors"]) or "gate not met"),
                 file=sys.stderr,
             )

@@ -29,19 +29,22 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..exec import config as exec_config
+from ..exec.core import (
+    guarded_dispatch,
+    plan_micro_batches,
+    rows_under_byte_budget,
+    run_ordered,
+)
 from ..ops import score as score_ops
 from ..ops import score_fused
 from ..ops import score_hist
 from ..ops import score_pallas
 from ..ops.encoding import (
-    DEFAULT_LENGTH_BUCKETS,
     ENCODINGS,
     RAGGED_CHUNK,
     UTF8,
-    bucket_length,
     chunk_document,
-    pad_batch,
-    rows_under_byte_budget,
     truncate_utf8,
     unpack_ragged_jit,
 )
@@ -85,24 +88,30 @@ DEFAULT_HEAVY_BATCH_SIZE = 1024
 # 0.93-0.95× the serial median on configs 1/2/3 — so the default stays 1;
 # the knob remains for other link profiles (e.g. co-located PCIe).
 DISPATCH_WORKERS = 1
-# Hard cap on a single micro-batch's padded bytes. Once a program has
-# executed, h2d transfers ride the real device link (a tunneled relay here:
-# ~30-90MB/s, bursty; pre-execution puts only stage locally and measure
-# misleadingly fast). End-to-end A/B on the config-1 bench: 4096×2048 = 8MB
-# batches beat both many smaller puts (per-transfer overhead) and 16MB
-# batches (coarser transfer/compute overlap) — 0.37s vs 0.48-0.71s per
-# 20k-doc pass.
+# Default cap on a single micro-batch's padded bytes (= the `batch_bytes`
+# config knob's built-in default; a tuning profile or LANGDETECT_BATCH_BYTES
+# overrides it per deployment). Once a program has executed, h2d transfers
+# ride the real device link (a tunneled relay here: ~30-90MB/s, bursty;
+# pre-execution puts only stage locally and measure misleadingly fast).
+# End-to-end A/B on the config-1 bench: 4096×2048 = 8MB batches beat both
+# many smaller puts (per-transfer overhead) and 16MB batches (coarser
+# transfer/compute overlap) — 0.37s vs 0.48-0.71s per 20k-doc pass.
 MAX_BATCH_BYTES = 8 << 20
 
 
-def rows_for_bucket(pad_to: int, batch_size: int) -> int:
+def rows_for_bucket(
+    pad_to: int, batch_size: int, byte_budget: int | None = None
+) -> int:
     """Micro-batch row count for a padded width: ``batch_size`` halved until
-    the padded transfer fits MAX_BATCH_BYTES (64-row floor). The single
-    policy site — `BatchRunner._execute` plans with it and `bench.py`'s
-    compute-only measurement reuses it so the timed shape can't drift from
-    what the runner actually dispatches. The halving itself is the helper
-    shared with the fit pipeline (`ops.encoding.rows_under_byte_budget`)."""
-    return rows_under_byte_budget(pad_to, MAX_BATCH_BYTES, batch_size)
+    the padded transfer fits the byte budget (64-row floor; ``byte_budget``
+    None ⇒ the resolved `batch_bytes` knob). The single policy site —
+    `BatchRunner._execute` plans with it and `bench.py`'s compute-only
+    measurement reuses it so the timed shape can't drift from what the
+    runner actually dispatches. The halving itself is the execution core's
+    `exec.core.rows_under_byte_budget`, shared with the fit pipeline."""
+    if byte_budget is None:
+        byte_budget = int(exec_config.resolve("batch_bytes"))
+    return rows_under_byte_budget(pad_to, byte_budget, batch_size)
 
 
 def resolve_device(backend: str):
@@ -202,7 +211,14 @@ class BatchRunner:
     lut: jnp.ndarray | None
     spec: VocabSpec
     batch_size: int | None = None  # None ⇒ auto per strategy
-    length_buckets: tuple[int, ...] = DEFAULT_LENGTH_BUCKETS
+    # Padded-length bucket lattice. None ⇒ resolved through exec.config at
+    # construction: LANGDETECT_LENGTH_BUCKETS, else the active tuning
+    # profile's measured lattice, else the built-in default — the runner
+    # loads the autotuner's output at startup.
+    length_buckets: tuple[int, ...] | None = None
+    # Byte budget per micro-batch transfer. None ⇒ exec.config resolution
+    # (env LANGDETECT_BATCH_BYTES > tuning profile > MAX_BATCH_BYTES).
+    batch_bytes: int | None = None
     # Window-axis scan block for the XLA strategies (gather/onehot) only;
     # the pallas kernel's window block is `pallas_block` (None ⇒ the kernel's
     # own default).
@@ -274,16 +290,27 @@ class BatchRunner:
         # Created first: strategy auto-selection below may already resolve
         # lazy state through the lock.
         self._state_lock = threading.Lock()
+        # Execution-core knob resolution (explicit ctor > env > tuning
+        # profile > default): the runner's shape lattice and transfer
+        # budget come from one audited config site, so a tuned profile
+        # lands here without any per-call-site plumbing.
+        self.length_buckets = tuple(
+            exec_config.resolve("length_buckets", self.length_buckets)
+        )
+        self.batch_bytes = int(
+            exec_config.resolve("batch_bytes", self.batch_bytes)
+        )
+        if self.dispatch_workers is None:
+            self.dispatch_workers = exec_config.resolve("dispatch_workers")
         if self.retry_policy is None:
             self.retry_policy = RetryPolicy.from_env()
         if self.breaker is None:
             self.breaker = CircuitBreaker.from_env(name="score")
         if self.degraded_fallback is None:
-            import os as _os
-
-            self.degraded_fallback = (
-                _os.environ.get("LANGDETECT_DEGRADED", "1") != "0"
-            )
+            # Through the audited config site so /varz's effective_config
+            # and the live behavior can't disagree ("false"/"off"/"no"
+            # now disable it too, not just "0").
+            self.degraded_fallback = bool(exec_config.resolve("degraded"))
         # True while the last dispatch rode the degradation ladder; drives
         # the langdetect_degraded gauge's reset on fast-path recovery.
         self._degraded_mode = False
@@ -1316,38 +1343,35 @@ class BatchRunner:
                     # Non-final chunks own starts [0, stride); final owns all.
                     limits.append(stride if j < len(parts) - 1 else self.max_chunk)
 
-        # Group chunks by padded-length bucket, then emit batches per bucket
-        # with the row count capped so no single transfer exceeds
-        # MAX_BATCH_BYTES — a batch of 8192-wide rows at the full pallas
+        # Micro-batch plan through the shared execution core
+        # (exec.core.plan_micro_batches): chunks grouped by padded-length
+        # bucket, rows capped so no single transfer exceeds the resolved
+        # batch_bytes budget — a batch of 8192-wide rows at the full pallas
         # batch size would be a 32MB transfer, past the h2d bandwidth cliff.
-        # A bucket's ragged remainder is carried into the next (wider) bucket
-        # instead of becoming its own under-filled batch: padding a few docs
-        # up one bucket is far cheaper than an extra dispatch + compile
-        # shape, and the whole call ends with at most one ragged tail batch.
-        by_bucket: dict[int, list[int]] = {}
-        for k in range(len(chunks)):
-            b = bucket_length(len(chunks[k]) or 1, self.length_buckets)
-            by_bucket.setdefault(b, []).append(k)
-
-        def rows_for(pad_to: int) -> int:
-            return rows_for_bucket(pad_to, self.batch_size)
-
-        plan: list[tuple[np.ndarray, int]] = []
-        carry: list[int] = []
-        for pad_to in sorted(by_bucket):
-            idxs = carry + by_bucket[pad_to]
-            rows = rows_for(pad_to)
-            full_end = len(idxs) - len(idxs) % rows
-            for start in range(0, full_end, rows):
-                plan.append((np.asarray(idxs[start : start + rows]), pad_to))
-            carry = idxs[full_end:]
-        if carry:
-            pad_to = bucket_length(
-                max(len(chunks[k]) for k in carry) or 1, self.length_buckets
+        # A bucket's ragged remainder is carried into the next (wider)
+        # bucket instead of becoming its own under-filled batch, so the
+        # whole call ends with at most one ragged tail batch.
+        sizes = [len(c) for c in chunks]
+        plan = plan_micro_batches(
+            sizes,
+            length_buckets=self.length_buckets,
+            rows_for=lambda pad_to: rows_under_byte_budget(
+                pad_to, self.batch_bytes, self.batch_size
+            ),
+        )
+        # Tuner signal (exec.tune): the chunk-length distribution at 64-byte
+        # granularity, as counters so it rides every snapshot event. This is
+        # the exact population the bucket-width solver replays — recorded
+        # here, after truncation and chunking, because this is the
+        # population the lattice actually pads.
+        if sizes:
+            edges = np.minimum(
+                -(-np.maximum(np.asarray(sizes, dtype=np.int64), 1) // 64)
+                * 64,
+                self.max_chunk,
             )
-            rows = rows_for(pad_to)
-            for start in range(0, len(carry), rows):
-                plan.append((np.asarray(carry[start : start + rows]), pad_to))
+            for edge, cnt in zip(*np.unique(edges, return_counts=True)):
+                REGISTRY.incr(f"exec/len/{int(edge)}", int(cnt))
         from ..utils.profiling import trace
 
         def build_and_dispatch(sel: np.ndarray, pad_to: int):
@@ -1384,6 +1408,11 @@ class BatchRunner:
                 fill = real_bytes / capacity if capacity else 1.0
                 REGISTRY.observe("score/batch_fill_ratio", fill)
                 REGISTRY.observe("score/padding_waste", 1.0 - fill)
+                # Aggregate padding-tax counters: whole-run fill is exactly
+                # real/capacity (the histograms are sampled reservoirs) —
+                # what the tune smoke gate and the compare guard read.
+                REGISTRY.incr("score/real_bytes", real_bytes)
+                REGISTRY.incr("score/capacity_bytes", capacity)
 
             if (
                 self.ragged_transfer
@@ -1487,46 +1516,39 @@ class BatchRunner:
                 batch_docs, batch_limits, pad_to, placement, cause
             )
 
+        def on_recovered():
+            if self._degraded_mode and self.breaker.state == CLOSED:
+                # Fast path healthy again AND the breaker agrees (a
+                # success that only half-opened a multi-probe breaker
+                # isn't recovery yet): leave degraded mode and say so on
+                # the gauge.
+                self._degraded_mode = False
+                REGISTRY.set_gauge("langdetect_degraded", 0.0)
+                log_event(_log, "runner.degraded_recovered")
+
         def dispatch_recover(sel, pad_to):
-            """Breaker-gated fast path under the retry policy, then the
-            degradation ladder. On a multi-process mesh (or with the
-            fallback disabled) only the policy replay applies: the chaos
-            plan and the policy are deterministic, so every process
-            replays together and the collective schedule stays aligned —
-            but a per-process fallback would not."""
-            fast = lambda: build_and_dispatch(sel, pad_to)  # noqa: E731
-            if multiproc or not self.degraded_fallback:
-                return self.retry_policy.run(
-                    fast, site="score/dispatch", on_retry=on_retry,
-                    log_fields={"rows": len(sel)},
-                )
-            cause = None
-            if self.breaker.allow():
-                try:
-                    scores = self.retry_policy.run(
-                        fast,
-                        site="score/dispatch",
-                        breaker=self.breaker,
-                        on_retry=on_retry,
-                        log_fields={"rows": len(sel)},
-                    )
-                except Exception as e:
-                    if not self.retry_policy.classify(e):
-                        raise
-                    cause = e
-                else:
-                    if self._degraded_mode and self.breaker.state == CLOSED:
-                        # Fast path healthy again AND the breaker agrees
-                        # (a success that only half-opened a multi-probe
-                        # breaker isn't recovery yet): leave degraded mode
-                        # and say so on the gauge.
-                        self._degraded_mode = False
-                        REGISTRY.set_gauge("langdetect_degraded", 0.0)
-                        log_event(_log, "runner.degraded_recovered")
-                    return scores
-            else:
-                REGISTRY.incr("resilience/breaker_short_circuit")
-            return degraded_for(sel, pad_to, cause)
+            """The execution core's shared failure wiring
+            (exec.core.guarded_dispatch): breaker-gated fast path under
+            the retry policy, then the degradation ladder. On a
+            multi-process mesh (or with the fallback disabled) only the
+            policy replay applies: the chaos plan and the policy are
+            deterministic, so every process replays together and the
+            collective schedule stays aligned — but a per-process
+            fallback would not."""
+            fallback_ok = not multiproc and self.degraded_fallback
+            return guarded_dispatch(
+                lambda: build_and_dispatch(sel, pad_to),
+                policy=self.retry_policy,
+                site="score/dispatch",
+                breaker=self.breaker if fallback_ok else None,
+                degraded=(
+                    (lambda cause: degraded_for(sel, pad_to, cause))
+                    if fallback_ok else None
+                ),
+                on_retry=on_retry,
+                on_recovered=on_recovered,
+                log_fields={"rows": len(sel)},
+            )
 
         def run_one(item):
             """Pack, dispatch, and project one planned batch (transient
@@ -1572,13 +1594,9 @@ class BatchRunner:
             "score", docs=N, batches=len(plan), strategy=self.strategy,
             strategy_reason=getattr(self, "strategy_reason", "explicit"),
         ) as score_span:
-            if workers > 1:
-                from concurrent.futures import ThreadPoolExecutor
-
-                with ThreadPoolExecutor(max_workers=workers) as ex:
-                    pending = list(ex.map(run_one, plan))
-            else:
-                pending = [run_one(item) for item in plan]
+            # The core's plan executor: serial, or a few threads
+            # overlapping one batch's pack/put with another's round-trip.
+            pending = run_ordered(plan, run_one, workers)
 
             # Results stream back asynchronously: each batch's d2h copy is
             # started as soon as every batch is dispatched (payloads are tiny

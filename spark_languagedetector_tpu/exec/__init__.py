@@ -1,0 +1,22 @@
+"""Execution core: one scheduler/planner under batch, stream, and serve.
+
+:mod:`.core` holds the shared machinery (byte-budget row sizing, the
+micro-batch planner, ordered prefetch, retry/degrade wiring, the serve
+admission queue); :mod:`.config` resolves every ``LANGDETECT_*`` knob with
+one precedence rule; :mod:`.profile` is the versioned tuning profile, and
+:mod:`.tune` the offline autotuner CLI that emits it:
+
+    python -m spark_languagedetector_tpu.exec.tune telemetry.jsonl -o p.json
+    LANGDETECT_TUNING_PROFILE=p.json python serve...
+"""
+
+from . import config  # noqa: F401
+from .core import (  # noqa: F401
+    AdmissionQueue,
+    guarded_dispatch,
+    ordered_prefetch,
+    plan_micro_batches,
+    rows_under_byte_budget,
+    run_ordered,
+)
+from .profile import TuningProfile  # noqa: F401

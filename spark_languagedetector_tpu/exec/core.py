@@ -1,0 +1,441 @@
+"""The execution core: one scheduler under batch, stream, and serve.
+
+Three front ends used to re-implement the same machinery independently —
+``BatchRunner._execute`` planned micro-batches inline, ``stream/microbatch``
+kept its own prefetch deque, ``serve/batcher`` its own admission queue, and
+the byte-budget row sizing existed twice (``api.runner.rows_for_bucket`` /
+``ops.fit_pipeline.rows_for_fit_bucket``). The pjit/TPUv4 serving lesson
+(arXiv:2204.06514) and GSPMD (arXiv:2105.04663) both reduce to the same
+economics: a small closed set of compiled shapes reused forever, which makes
+the admission/bucketing layer the real throughput ceiling. This module is
+that layer, once:
+
+  * :func:`rows_under_byte_budget` / :func:`rows_for_bucket` — the single
+    byte-budget row-sizing policy (moved here from ``ops.encoding``; the
+    runner and the fit pipeline re-export it);
+  * :func:`plan_micro_batches` — the bucket-group / carry / ragged-tail
+    micro-batch planner shared by the scoring runner and the device fit;
+  * :func:`run_ordered` — the serial-or-threaded plan executor (the batch
+    path's dispatch loop);
+  * :func:`ordered_prefetch` — the bounded, ordered producer/consumer
+    pipeline under both the streaming engine's prefetch path and the fit
+    ingest's packer;
+  * :func:`guarded_dispatch` — the breaker-gated fast path + classified
+    retry + degraded-ladder hand-off (docs/RESILIENCE.md) the runner's
+    dispatch rides;
+  * :class:`AdmissionQueue` — priority lanes, bounded rows, flush-window
+    coalescing and explicit shedding behind ``serve/batcher``.
+
+Everything here is host-side policy: no jax imports, no device work. The
+knobs these pieces consume resolve through :mod:`.config` (explicit ctor
+values > env > tuning profile > built-in default), and the offline
+:mod:`.tune` CLI replays a telemetry capture to pick the profile values.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Iterable, Iterator, Sequence
+
+import numpy as np
+
+from ..telemetry import REGISTRY
+
+# Re-exported bucket helper: the lattice membership function is an encoding
+# concept (ops.encoding defines the default lattice too); the planner here
+# is its only policy consumer.
+from ..ops.encoding import bucket_length  # noqa: F401
+
+
+# ------------------------------------------------------- byte-budget math ---
+def rows_under_byte_budget(
+    pad_to: int, byte_budget: int, max_rows: int, floor: int = 64
+) -> int:
+    """Micro-batch rows for a padded width: ``max_rows`` halved until the
+    padded transfer fits ``byte_budget``, never below ``floor``. The single
+    halving policy shared by the scoring runner (``batch_bytes``) and the
+    fit pipeline (``fit_batch_bytes``), so the two paths' compile-shape
+    lattices can't drift. Halving (not dividing) keeps the (rows, pad_to)
+    set a small closed lattice — only power-of-two fractions of the cap
+    ever compile."""
+    rows = max_rows
+    while rows * pad_to > byte_budget and rows > floor:
+        rows //= 2
+    return rows
+
+
+# ------------------------------------------------------- micro-batch plan ---
+def plan_micro_batches(
+    sizes: Sequence[int],
+    *,
+    length_buckets: Sequence[int],
+    rows_for: Callable[[int], int],
+    order: Sequence[int] | None = None,
+) -> list[tuple[np.ndarray, int]]:
+    """The shared micro-batch plan: group work items by padded-length
+    bucket, emit ``rows_for(pad_to)``-row batches per bucket, and carry
+    each bucket's ragged remainder into the next wider bucket so the whole
+    plan ends with at most one ragged tail batch (padding a few items up
+    one bucket is far cheaper than an extra dispatch + compile shape).
+
+    ``sizes`` are the item byte lengths; ``order`` is the iteration order
+    (the scoring runner passes input order, the fit pipeline a stable
+    length sort). Returns ``[(sel indices ndarray, pad_to), ...]`` with
+    every ``pad_to`` a member of ``length_buckets`` — callers chunk-split
+    oversized items beforehand, so no per-width recompiles ever happen.
+    """
+    idx_iter: Iterable[int] = range(len(sizes)) if order is None else order
+    by_bucket: dict[int, list[int]] = {}
+    for i in idx_iter:
+        b = bucket_length(sizes[i] or 1, length_buckets)
+        by_bucket.setdefault(b, []).append(int(i))
+    plan: list[tuple[np.ndarray, int]] = []
+    carry: list[int] = []
+    for pad_to in sorted(by_bucket):
+        idxs = carry + by_bucket[pad_to]
+        rows = rows_for(pad_to)
+        full_end = len(idxs) - len(idxs) % rows
+        for start in range(0, full_end, rows):
+            plan.append((np.asarray(idxs[start : start + rows]), pad_to))
+        carry = idxs[full_end:]
+    if carry:
+        pad_to = bucket_length(
+            max(sizes[i] for i in carry) or 1, length_buckets
+        )
+        rows = rows_for(pad_to)
+        for start in range(0, len(carry), rows):
+            plan.append((np.asarray(carry[start : start + rows]), pad_to))
+    return plan
+
+
+def run_ordered(plan: Sequence, fn: Callable, workers: int) -> list:
+    """Run ``fn`` over every planned item, results in plan order.
+
+    ``workers > 1`` overlaps one item's host work (pack + device_put
+    round-trips release the GIL) with another's — the batch path's
+    dispatch loop. Serial when the plan is short or one worker suffices.
+    """
+    workers = max(1, min(int(workers), len(plan)))
+    if workers > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=workers) as ex:
+            return list(ex.map(fn, plan))
+    return [fn(item) for item in plan]
+
+
+# ------------------------------------------------------- ordered prefetch ---
+def ordered_prefetch(
+    it: Iterable,
+    fn: Callable,
+    *,
+    depth: int = 0,
+    workers: int = 1,
+    abort_wait: bool = True,
+) -> Iterator[tuple[object, Callable, bool, int]]:
+    """Bounded, ordered producer/consumer pipeline over ``it``.
+
+    Yields ``(item, thunk, prefetched, pending)`` per source item, in
+    source order; ``thunk()`` returns (or raises) ``fn(item)``'s result.
+    With ``depth == 0`` nothing runs ahead — ``thunk`` computes inline
+    when called (the caller keeps its synchronous semantics and its own
+    spans/timers around the call). With ``depth > 0``, up to ``depth``
+    items beyond the yielded one are in flight on ``workers`` threads,
+    and items are pulled from ``it`` at most ``depth + 1`` ahead of the
+    consumer — a consuming source (Kafka) never loses more than the
+    pipeline depth on a crash, exactly the old deque's bound.
+
+    ``pending`` counts the in-flight items *including* the yielded one
+    (the streaming engine's queue-depth signal). Closing the generator
+    cancels not-yet-started work; with ``abort_wait`` (the default) it
+    also joins the pool, so a consumer exception leaves no worker behind
+    and the next run's device dispatches can't interleave with a
+    leftover one's — required wherever dispatch order matters (the
+    streaming engine; multi-process meshes enqueue collectives in
+    lockstep). ``abort_wait=False`` returns without joining a possibly
+    wedged worker (the fit packer's choice: an h2d put stuck on a dead
+    link must not turn a fit abort into a hang; the orphan is joined at
+    interpreter exit).
+    """
+    it = iter(it)
+    if depth <= 0:
+        for item in it:
+            yield item, (lambda item=item: fn(item)), False, 1
+        return
+    from concurrent.futures import ThreadPoolExecutor
+
+    ex = ThreadPoolExecutor(max_workers=max(1, workers))
+    in_flight: deque = deque()
+    drained = False
+    try:
+        while True:
+            while len(in_flight) <= depth:
+                try:
+                    item = next(it)
+                except StopIteration:
+                    break
+                in_flight.append((item, ex.submit(fn, item)))
+            if not in_flight:
+                drained = True
+                return
+            pending = len(in_flight)
+            item, fut = in_flight.popleft()
+            yield item, fut.result, True, pending
+    finally:
+        # Drained normally: the pool is idle, a waiting shutdown is
+        # instant. Aborted: cancel what hasn't started, and join (or
+        # not) per ``abort_wait`` — see the docstring.
+        ex.shutdown(wait=drained or abort_wait, cancel_futures=True)
+
+
+# --------------------------------------------------- retry/degrade wiring ---
+def guarded_dispatch(
+    fast: Callable[[], object],
+    *,
+    policy,
+    site: str,
+    breaker=None,
+    degraded: Callable[[BaseException | None], object] | None = None,
+    on_retry=None,
+    on_recovered: Callable[[], None] | None = None,
+    log_fields: dict | None = None,
+):
+    """The shared failure wiring around one dispatch (docs/RESILIENCE.md):
+    breaker-gated fast path under the classified retry ``policy``, then the
+    ``degraded`` ladder.
+
+    With ``degraded=None`` (multi-process meshes, or the fallback disabled)
+    only the policy replay applies — deterministic plans replay in lockstep
+    on every process, but a per-process fallback would desynchronize the
+    collective schedule, so there is none. Otherwise: while the breaker
+    admits, the fast path runs under the policy; a retryable exhaustion
+    falls through to ``degraded(cause)``; a success after degraded batches
+    calls ``on_recovered`` once the breaker agrees the path is healthy. An
+    open breaker short-circuits straight to the ladder
+    (``resilience/breaker_short_circuit``).
+    """
+    if degraded is None:
+        return policy.run(
+            fast, site=site, on_retry=on_retry, log_fields=log_fields
+        )
+    cause: BaseException | None = None
+    if breaker is None or breaker.allow():
+        try:
+            result = policy.run(
+                fast,
+                site=site,
+                breaker=breaker,
+                on_retry=on_retry,
+                log_fields=log_fields,
+            )
+        except Exception as e:
+            if not policy.classify(e):
+                raise
+            cause = e
+        else:
+            if on_recovered is not None:
+                on_recovered()
+            return result
+    else:
+        REGISTRY.incr("resilience/breaker_short_circuit")
+    return degraded(cause)
+
+
+# --------------------------------------------------------- admission queue --
+class AdmissionQueue:
+    """Priority-lane admission queue with flush-window coalescing and
+    explicit shedding — the serving front end's half of the core
+    (``serve/batcher`` wraps it; the semantics are pinned by
+    ``tests/test_serve.py``).
+
+    Items are admitted into lanes (drained in ``lanes`` order — a bulk
+    backlog must never delay an interactive request) and popped as one
+    coalesced batch by :meth:`next_batch`: the flush fires when
+    ``max_rows`` are queued or the oldest admitted item has waited
+    ``max_wait_s``. Backpressure is reject-newest and explicit —
+    :meth:`admit` returns a shed reason (queue past ``max_queue_rows``,
+    estimated wait past ``slo_s``, or the caller's ``shed_probe``) instead
+    of queueing into a blown SLO. One consumer thread is assumed (the
+    dispatcher); any number of producers may admit concurrently.
+    """
+
+    def __init__(
+        self,
+        *,
+        max_rows: int,
+        max_wait_s: float,
+        max_queue_rows: int,
+        slo_s: float = 0.0,
+        lanes: Sequence[str] = ("interactive", "bulk"),
+        shed_probe: Callable[[str], str | None] | None = None,
+        on_change: Callable[[int, int], None] | None = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if max_rows < 1 or max_queue_rows < 1:
+            raise ValueError("max_rows and max_queue_rows must be >= 1")
+        self.max_rows = int(max_rows)
+        self.max_wait_s = float(max_wait_s)
+        self.max_queue_rows = int(max_queue_rows)
+        self.slo_s = float(slo_s)
+        self.lanes = tuple(lanes)
+        self._shed_probe = shed_probe
+        self._on_change = on_change
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        # lane -> deque of (item, rows, admitted_at)
+        self._queues: dict[str, deque] = {p: deque() for p in self.lanes}
+        self.queued_rows = 0
+        self.inflight_rows = 0
+        # Rows/s over recent dispatches (EMA): the estimated-wait shed
+        # signal. Zero until the first dispatch lands.
+        self.ema_rows_per_s = 0.0
+        self.closed = False
+
+    # ------------------------------------------------------------- admit ----
+    def admit(self, item, rows: int, lane: str) -> tuple[str | None, float]:
+        """Atomically admit one item, or return why it was shed.
+
+        Returns ``(None, est_wait_s)`` on admission, else
+        ``(reason, est_wait_s)`` with the item NOT queued. Reasons:
+        ``"closed"``, ``"queue_full"``, ``"slo"``, or whatever the
+        ``shed_probe`` returned for this lane. Reject-newest: queued work
+        is never evicted."""
+        if lane not in self._queues:
+            raise ValueError(
+                f"unknown lane {lane!r}; expected one of {self.lanes}"
+            )
+        with self._cv:
+            if self.closed:
+                return "closed", 0.0
+            backlog = self.queued_rows + self.inflight_rows
+            wait_s = (
+                backlog / self.ema_rows_per_s
+                if self.ema_rows_per_s > 0
+                else 0.0
+            )
+            if self.queued_rows + rows > self.max_queue_rows:
+                return "queue_full", wait_s
+            if self.slo_s > 0 and wait_s > self.slo_s:
+                return "slo", wait_s
+            if self._shed_probe is not None:
+                reason = self._shed_probe(lane)
+                if reason is not None:
+                    return reason, wait_s
+            self._queues[lane].append((item, int(rows), self._clock()))
+            self.queued_rows += rows
+            self._notify_change_locked()
+            self._cv.notify_all()
+        return None, wait_s
+
+    def _notify_change_locked(self) -> None:
+        if self._on_change is not None:
+            depth = sum(len(q) for q in self._queues.values())
+            self._on_change(depth, self.queued_rows)
+
+    def _oldest_locked(self) -> float | None:
+        ages = [q[0][2] for q in self._queues.values() if q]
+        return min(ages) if ages else None
+
+    def _take_locked(self, key) -> list:
+        """Pop one coalesced batch: lanes in priority order, whole items
+        only, until ``max_rows`` is reached (the first item is always
+        taken, even when larger). ``key(item)`` partitions items that
+        cannot share a dispatch — a key flip at a lane front ends the
+        batch there (it leads the next one)."""
+        batch: list = []
+        rows = 0
+        lead_key = None
+        for lane in self.lanes:
+            q = self._queues[lane]
+            while q and (rows < self.max_rows or not batch):
+                if key is not None:
+                    k = key(q[0][0])
+                    if batch and k != lead_key:
+                        break
+                    lead_key = k
+                item, item_rows, _ = q.popleft()
+                batch.append(item)
+                rows += item_rows
+        self.queued_rows -= rows
+        self.inflight_rows = rows
+        self._notify_change_locked()
+        return batch
+
+    # -------------------------------------------------------------- take ----
+    def next_batch(self, *, key: Callable | None = None) -> list | None:
+        """Block until a coalesced batch is due, pop and return it; None
+        once the queue is closed and drained. The coalescing window is the
+        micro-batch analog of Nagle, bounded by the flush knobs: hold
+        until ``max_rows`` are queued or the oldest item has waited
+        ``max_wait_s`` (or the queue closes)."""
+        while True:
+            with self._cv:
+                while self.queued_rows == 0 and not self.closed:
+                    self._cv.wait()
+                if self.queued_rows == 0 and self.closed:
+                    return None
+                while self.queued_rows < self.max_rows:
+                    oldest = self._oldest_locked()
+                    if oldest is None:
+                        break
+                    remaining = oldest + self.max_wait_s - self._clock()
+                    if remaining <= 0 or self.closed:
+                        break
+                    self._cv.wait(remaining)
+                if self.queued_rows == 0:
+                    continue
+                return self._take_locked(key)
+
+    def done(self) -> None:
+        """Mark the in-flight batch settled (the consumer calls this after
+        every dispatch, success or failure)."""
+        with self._cv:
+            self.inflight_rows = 0
+            self._cv.notify_all()
+
+    def record_rate(self, rows: int, seconds: float) -> None:
+        """Fold one dispatch's throughput into the shed-signal EMA."""
+        if seconds <= 0:
+            return
+        rate = rows / seconds
+        with self._lock:
+            self.ema_rows_per_s = (
+                rate
+                if self.ema_rows_per_s == 0.0
+                else 0.7 * self.ema_rows_per_s + 0.3 * rate
+            )
+
+    # ------------------------------------------------------------- admin ----
+    def close(self, drain: bool = True) -> list:
+        """Stop admitting. With ``drain`` the queued items stay for the
+        consumer; otherwise they are evicted and returned so the caller
+        can fail them explicitly (never a silent drop)."""
+        evicted: list = []
+        with self._cv:
+            self.closed = True
+            if not drain:
+                for q in self._queues.values():
+                    while q:
+                        item, rows, _ = q.popleft()
+                        self.queued_rows -= rows
+                        evicted.append(item)
+                self._notify_change_locked()
+            self._cv.notify_all()
+        return evicted
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "queue_depth": sum(len(q) for q in self._queues.values()),
+                "queued_rows": self.queued_rows,
+                "inflight_rows": self.inflight_rows,
+                "ema_rows_per_s": round(self.ema_rows_per_s, 3),
+                "max_rows": self.max_rows,
+                "max_wait_ms": self.max_wait_s * 1e3,
+                "max_queue_rows": self.max_queue_rows,
+                "slo_ms": self.slo_s * 1e3,
+                "closed": self.closed,
+            }

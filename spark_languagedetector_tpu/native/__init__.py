@@ -156,13 +156,23 @@ def default_pack_threads() -> int:
     min(8, cores), overridable via ``LANGDETECT_PACK_THREADS`` (e.g. to
     leave cores free for a consumer thread pipelined against the packer,
     or to pin single-threaded packing in latency-sensitive tests). One
-    policy site for both the padded and ragged loaders."""
-    raw = os.environ.get("LANGDETECT_PACK_THREADS")
-    if raw:
-        try:
-            return max(1, int(raw))
-        except ValueError:
-            log_event(_log, "native.bad_pack_threads", value=raw)
+    policy site for both the padded and ragged loaders; the value resolves
+    through ``exec.config`` so it matches what ``/varz`` reports — but a
+    malformed env value logs and falls back here instead of raising: the
+    packer sits on the fit/score hot path, and a typo'd tuning knob must
+    never take scoring down."""
+    try:
+        from ..exec import config as exec_config
+
+        threads = exec_config.resolve("pack_threads")
+    except ValueError:
+        log_event(
+            _log, "native.bad_pack_threads",
+            value=os.environ.get("LANGDETECT_PACK_THREADS"),
+        )
+        threads = None
+    if threads is not None:
+        return max(1, int(threads))
     return min(8, os.cpu_count() or 1)
 
 

@@ -72,15 +72,13 @@ DEFAULT_LENGTH_BUCKETS: tuple[int, ...] = (
 def rows_under_byte_budget(
     pad_to: int, byte_budget: int, max_rows: int, floor: int = 64
 ) -> int:
-    """Micro-batch rows for a padded width: ``max_rows`` halved until the
-    padded transfer fits ``byte_budget``, never below ``floor``. The single
-    halving policy shared by the scoring runner (``MAX_BATCH_BYTES``) and
-    the fit pipeline (``LANGDETECT_FIT_BATCH_BYTES``), so the two paths'
-    compile-shape lattices can't drift."""
-    rows = max_rows
-    while rows * pad_to > byte_budget and rows > floor:
-        rows //= 2
-    return rows
+    """Back-compat alias: the byte-budget row-sizing policy moved to the
+    execution core (``exec.core.rows_under_byte_budget`` — one policy under
+    the scoring runner, the fit pipeline, and the autotuner). Lazy import:
+    the core imports this module for :func:`bucket_length`."""
+    from ..exec.core import rows_under_byte_budget as _core
+
+    return _core(pad_to, byte_budget, max_rows, floor)
 
 
 def pad_batch(

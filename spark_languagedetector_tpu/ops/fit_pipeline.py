@@ -47,22 +47,19 @@ mesh paths).
 
 from __future__ import annotations
 
-import os
-import queue
-import threading
 from typing import Sequence
 
 import numpy as np
 
-from ..telemetry import REGISTRY, span
-from ..utils.logging import get_logger
-from .encoding import (
-    DEFAULT_LENGTH_BUCKETS,
-    RAGGED_CHUNK,
-    bucket_length,
-    round_chunks,
+from ..exec import config as exec_config
+from ..exec.core import (
+    ordered_prefetch,
+    plan_micro_batches,
     rows_under_byte_budget,
 )
+from ..telemetry import REGISTRY, span
+from ..utils.logging import get_logger
+from .encoding import RAGGED_CHUNK, bucket_length, round_chunks
 from .vocab import VocabSpec
 
 _log = get_logger("ops.fit_pipeline")
@@ -86,34 +83,24 @@ MIN_FIT_ROWS = 64
 FIT_PIPELINE_DEPTH = 2
 
 
-def _positive_env_int(name: str) -> int | None:
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return None
-    try:
-        value = int(raw)
-    except ValueError as e:
-        raise ValueError(f"{name} must be an integer, got {raw!r}") from e
-    if value <= 0:
-        raise ValueError(f"{name} must be positive, got {value}")
-    return value
-
-
 def resolve_fit_batching(batch_rows: int | None = None) -> tuple[int | None, int]:
     """(fixed_rows | None, byte_budget) for the fit's micro-batch plan.
 
     An explicit ``batch_rows`` (the estimator's ``fitBatchRows`` param or a
     direct ``fit_profile_device`` argument) wins; otherwise the
     ``LANGDETECT_FIT_BATCH_ROWS`` env var forces a fixed row count; otherwise
-    rows adapt per length bucket under the ``LANGDETECT_FIT_BATCH_BYTES``
-    budget (default :data:`DEFAULT_FIT_BATCH_BYTES`).
+    rows adapt per length bucket under the byte budget — env
+    ``LANGDETECT_FIT_BATCH_BYTES``, else the tuning profile's
+    ``fit_batch_bytes``, else :data:`DEFAULT_FIT_BATCH_BYTES` (the full
+    precedence lives in ``exec.config``).
     """
-    budget = _positive_env_int(BYTES_ENV) or DEFAULT_FIT_BATCH_BYTES
+    budget = int(exec_config.resolve("fit_batch_bytes"))
     if batch_rows is not None:
         if batch_rows <= 0:
             raise ValueError(f"batch_rows must be positive, got {batch_rows}")
         return int(batch_rows), budget
-    return _positive_env_int(ROWS_ENV), budget
+    rows = exec_config.resolve("fit_batch_rows")
+    return (None if rows is None else int(rows)), budget
 
 
 def rows_for_fit_bucket(
@@ -148,7 +135,7 @@ def plan_fit_batches(
     *,
     batch_rows: int | None = None,
     byte_budget: int = DEFAULT_FIT_BATCH_BYTES,
-    length_buckets: Sequence[int] = DEFAULT_LENGTH_BUCKETS,
+    length_buckets: Sequence[int] | None = None,
 ):
     """Deterministic micro-batch plan for the device fit's ingest.
 
@@ -171,6 +158,10 @@ def plan_fit_batches(
         ``spec.gram_to_id``), or None. Scatter-added once through the fit's
         ``extra_counts`` path, they make the split exactly count-preserving.
     """
+    if length_buckets is None:
+        # The tuned lattice (exec.config: env > tuning profile > default) —
+        # fit and score share one bucket set so the compiled shapes overlap.
+        length_buckets = exec_config.resolve("length_buckets")
     max_len = length_buckets[-1]
     max_gram = max(spec.gram_lengths)
     lang_arr = np.asarray(lang_indices)
@@ -210,25 +201,15 @@ def plan_fit_batches(
                 (np.asarray(sel), bucket_length(max(longest, 1), length_buckets))
             )
     else:
-        by_bucket: dict[int, list[int]] = {}
-        for i in order:
-            b = bucket_length(len(items[i]) or 1, length_buckets)
-            by_bucket.setdefault(b, []).append(int(i))
-        carry: list[int] = []
-        for b in sorted(by_bucket):
-            idxs = carry + by_bucket[b]
-            rows = rows_for_fit_bucket(b, byte_budget)
-            full = len(idxs) - len(idxs) % rows
-            for start in range(0, full, rows):
-                plan.append((np.asarray(idxs[start : start + rows]), b))
-            carry = idxs[full:]
-        if carry:
-            b = bucket_length(
-                max(len(items[i]) for i in carry) or 1, length_buckets
-            )
-            rows = rows_for_fit_bucket(b, byte_budget)
-            for start in range(0, len(carry), rows):
-                plan.append((np.asarray(carry[start : start + rows]), b))
+        # The shared core planner (exec.core): per-bucket grouping with the
+        # remainder carried into the next wider bucket — the same plan the
+        # scoring runner emits, in the fit's length-sorted order.
+        plan = plan_micro_batches(
+            [len(d) for d in items],
+            length_buckets=length_buckets,
+            rows_for=lambda b: rows_for_fit_bucket(b, byte_budget),
+            order=order,
+        )
 
     straddle = None
     if corr:
@@ -237,16 +218,6 @@ def plan_fit_batches(
         )
         straddle = (e[:, 0], e[:, 1], e[:, 2])
     return items, langs_np, plan, straddle
-
-
-class _Failure:
-    __slots__ = ("error",)
-
-    def __init__(self, error: BaseException):
-        self.error = error
-
-
-_DONE = object()
 
 
 def iter_device_batches(
@@ -263,18 +234,20 @@ def iter_device_batches(
     """Yield ``(batch, lengths, lang_ids, rows, pad_to)`` device operands for
     every planned micro-batch, with packing and transfer pipelined ahead.
 
-    A background packer thread walks ``plan`` in order: native pack (ragged
-    when the chunk-aligned flat buffer beats the padded form — size precheck
-    identical to the scoring runner's), mesh row padding (``ndata`` > 1),
-    async ``device_put`` to ``placement``, then a bounded queue hand-off —
-    up to ``depth`` batches sit transferred-or-transferring beyond the one
-    the consumer holds, so the count step never waits on the host. Ragged
-    batches are rebuilt into the exact padded form on device by the shared
-    ``unpack_ragged_jit`` gather in the *consumer* thread, keeping every
-    compiled-program dispatch in deterministic plan order (multi-process
-    meshes require identical collective enqueue order on every process;
-    ``device_put`` of addressable shards is not a collective, but the puts
-    are plan-ordered too).
+    A background packer (the execution core's :func:`ordered_prefetch`
+    pipeline, one worker so packs stay plan-ordered) walks ``plan`` in
+    order: native pack (ragged when the chunk-aligned flat buffer beats the
+    padded form — size precheck identical to the scoring runner's), mesh
+    row padding (``ndata`` > 1), async ``device_put`` to ``placement``,
+    then an ordered hand-off — up to ``depth`` batches sit
+    transferred-or-transferring beyond the one the consumer holds, so the
+    count step never waits on the host. Ragged batches are rebuilt into the
+    exact padded form on device by the shared ``unpack_ragged_jit`` gather
+    in the *consumer* thread, keeping every compiled-program dispatch in
+    deterministic plan order (multi-process meshes require identical
+    collective enqueue order on every process; ``device_put`` of
+    addressable shards is not a collective, but the puts are plan-ordered
+    too).
 
     ``parent`` is the span the cross-thread ``fit/pack`` / ``fit/put`` spans
     attach under (pass the ``fit/count`` span's parent so they become
@@ -298,10 +271,9 @@ def iter_device_batches(
     # processes' devices is not portable on this jax version — ship host
     # arrays and let the pjit in_shardings place them at dispatch.
     explicit_put = placement is None or jax.process_count() == 1
-    stop = threading.Event()
-    q: queue.Queue = queue.Queue(maxsize=max(1, depth))
 
-    def pack_one(sel: np.ndarray, pad_to: int):
+    def pack_one(planned):
+        sel, pad_to = planned
         batch_docs = [items[k] for k in sel]
         blangs = item_langs[sel]
         if ndata > 1:
@@ -337,6 +309,11 @@ def iter_device_batches(
         fill = real_bytes / capacity if capacity else 1.0
         REGISTRY.observe("fit/batch_fill_ratio", fill)
         REGISTRY.observe("fit/padding_waste", 1.0 - fill)
+        # Aggregate padding-tax counters: exact whole-run fill is
+        # real/capacity (the per-batch histogram is a sampled reservoir);
+        # the tuner's smoke gate and the compare guard read these.
+        REGISTRY.incr("fit/real_bytes", real_bytes)
+        REGISTRY.incr("fit/capacity_bytes", capacity)
         blangs = np.ascontiguousarray(blangs, dtype=np.int32)
         REGISTRY.incr(
             "fit/wire_bytes", sum(a.nbytes for a in host) + blangs.nbytes
@@ -353,35 +330,19 @@ def iter_device_batches(
             dev, blangs_dev = host, blangs
         return (use_ragged, dev, blangs_dev, rows, pad_to)
 
-    def _offer(item) -> None:
-        while not stop.is_set():
-            try:
-                q.put(item, timeout=0.05)
-                return
-            except queue.Full:
-                continue
-
-    def producer():
-        try:
-            for sel, pad_to in plan:
-                if stop.is_set():
-                    return
-                _offer(pack_one(sel, pad_to))
-        except BaseException as e:  # surfaced to the consumer, never lost
-            _offer(_Failure(e))
-        else:
-            _offer(_DONE)
-
-    worker = threading.Thread(target=producer, name="fit-packer", daemon=True)
-    worker.start()
+    # The core's bounded ordered pipeline, one packer worker: packs (and
+    # their async puts) stay in deterministic plan order, up to ``depth``
+    # packed batches run ahead of the consumer. Closing this generator
+    # closes the pipeline; abort_wait=False so a pack wedged on a stuck
+    # h2d link can't turn a fit abort into a hang (the historical
+    # daemon-packer semantics — chaos replay still starts clean because
+    # pending packs are cancelled and a straggler only writes telemetry).
+    pipeline = ordered_prefetch(
+        plan, pack_one, depth=max(1, depth), workers=1, abort_wait=False
+    )
     try:
-        while True:
-            got = q.get()
-            if got is _DONE:
-                break
-            if isinstance(got, _Failure):
-                raise got.error
-            use_ragged, dev, blangs_dev, rows, pad_to = got
+        for _, packed, _, _ in pipeline:
+            use_ragged, dev, blangs_dev, rows, pad_to = packed()
             if use_ragged:
                 flat, offs, lengths = dev
                 batch = unpack_ragged_jit(flat, offs, lengths, pad_to)
@@ -389,10 +350,4 @@ def iter_device_batches(
                 batch, lengths = dev
             yield batch, lengths, blangs_dev, rows, pad_to
     finally:
-        stop.set()
-        while True:
-            try:
-                q.get_nowait()
-            except queue.Empty:
-                break
-        worker.join(timeout=5.0)
+        pipeline.close()

@@ -38,16 +38,16 @@ between dispatches and every request is answered by exactly one version.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
-from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Sequence
 
 import numpy as np
 
+from ..exec import config as exec_config
+from ..exec.core import AdmissionQueue
 from ..resilience import faults
 from ..telemetry import REGISTRY, current_trace_id, new_trace_id, span, trace_request
 from ..utils.logging import get_logger, log_event
@@ -60,16 +60,20 @@ INTERACTIVE = "interactive"
 BULK = "bulk"
 LANES = (INTERACTIVE, BULK)
 
-# Env knobs (docs/SERVING.md §3); explicit ctor args win.
-MAX_WAIT_ENV = "LANGDETECT_SERVE_MAX_WAIT_MS"
-MAX_ROWS_ENV = "LANGDETECT_SERVE_MAX_ROWS"
-QUEUE_ROWS_ENV = "LANGDETECT_SERVE_QUEUE_ROWS"
-SLO_MS_ENV = "LANGDETECT_SERVE_SLO_MS"
+# Env knobs (docs/SERVING.md §3), resolved through exec.config: explicit
+# ctor args win, then the env spelling, then the tuning profile's measured
+# flush window (docs/PERFORMANCE.md §9), then the defaults. The names and
+# defaults below are views onto the one authoritative table
+# (exec.config.KNOBS) — kept as module constants for the import surface.
+MAX_WAIT_ENV = exec_config.KNOBS["serve_max_wait_ms"].env
+MAX_ROWS_ENV = exec_config.KNOBS["serve_max_rows"].env
+QUEUE_ROWS_ENV = exec_config.KNOBS["serve_queue_rows"].env
+SLO_MS_ENV = exec_config.KNOBS["serve_slo_ms"].env
 
-DEFAULT_MAX_WAIT_MS = 10.0
-DEFAULT_MAX_ROWS = 256
-DEFAULT_QUEUE_ROWS = 4096
-DEFAULT_SLO_MS = 0.0  # 0 ⇒ estimated-wait shedding off
+DEFAULT_MAX_WAIT_MS = exec_config.KNOBS["serve_max_wait_ms"].default
+DEFAULT_MAX_ROWS = exec_config.KNOBS["serve_max_rows"].default
+DEFAULT_QUEUE_ROWS = exec_config.KNOBS["serve_queue_rows"].default
+DEFAULT_SLO_MS = exec_config.KNOBS["serve_slo_ms"].default  # 0 ⇒ shed off
 
 
 class ServeError(RuntimeError):
@@ -95,13 +99,6 @@ class ServeDeadlineExceeded(ServeError):
 
 class ServeClosed(ServeError):
     """Submitted to a batcher that has been closed."""
-
-
-def _env_float(key: str, default: float) -> float:
-    try:
-        return float(os.environ.get(key, "") or default)
-    except ValueError:
-        return default
 
 
 @dataclass
@@ -190,35 +187,31 @@ class ContinuousBatcher:
         if not hasattr(source, "lease"):
             source = _StaticSource(source)
         self._source = source
-        self.max_wait_s = (
-            max_wait_ms if max_wait_ms is not None
-            else _env_float(MAX_WAIT_ENV, DEFAULT_MAX_WAIT_MS)
-        ) / 1000.0
-        self.max_rows = int(
-            max_rows if max_rows is not None
-            else _env_float(MAX_ROWS_ENV, DEFAULT_MAX_ROWS)
-        )
-        self.max_queue_rows = int(
-            max_queue_rows if max_queue_rows is not None
-            else _env_float(QUEUE_ROWS_ENV, DEFAULT_QUEUE_ROWS)
-        )
-        self.slo_s = (
-            slo_ms if slo_ms is not None
-            else _env_float(SLO_MS_ENV, DEFAULT_SLO_MS)
-        ) / 1000.0
-        if self.max_rows < 1 or self.max_queue_rows < 1:
-            raise ValueError("max_rows and max_queue_rows must be >= 1")
+        # Knob resolution through the audited config site: explicit ctor >
+        # env > tuning profile (the autotuner's measured flush window) >
+        # default. The batcher therefore loads the tuned profile at
+        # startup with zero extra plumbing.
         self.shed_bulk_when_degraded = shed_bulk_when_degraded
         self.name = name
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
-        self._lanes: dict[str, deque[_Request]] = {p: deque() for p in LANES}
-        self._queued_rows = 0
-        self._inflight_rows = 0
-        # Rows/s over recent dispatches (EMA): the estimated-wait shed
-        # signal. Zero until the first dispatch lands.
-        self._ema_rows_per_s = 0.0
-        self._closed = False
+        # The execution core's admission queue owns lanes, bounds, the
+        # flush window, and the shed policy; the batcher supplies the
+        # serving-specific pieces — the degraded-bulk probe and the gauge
+        # names — and the dispatch itself. The knob attributes below are
+        # live views onto the queue, so runtime mutation (tests, the shed
+        # drill in bench --smoke-serve) keeps working.
+        self._queue = AdmissionQueue(
+            max_rows=int(exec_config.resolve("serve_max_rows", max_rows)),
+            max_wait_s=float(
+                exec_config.resolve("serve_max_wait_ms", max_wait_ms)
+            ) / 1000.0,
+            max_queue_rows=int(
+                exec_config.resolve("serve_queue_rows", max_queue_rows)
+            ),
+            slo_s=float(exec_config.resolve("serve_slo_ms", slo_ms)) / 1000.0,
+            lanes=LANES,
+            shed_probe=self._degraded_probe,
+            on_change=self._on_queue_change,
+        )
         self._thread = threading.Thread(
             target=self._run, name=f"{name}-batcher", daemon=True
         )
@@ -228,6 +221,66 @@ class ContinuousBatcher:
             max_rows=self.max_rows, max_queue_rows=self.max_queue_rows,
             slo_ms=self.slo_s * 1e3,
         )
+
+    def _degraded_probe(self, lane: str) -> str | None:
+        """Admission-time health shed: while the serving runner's breaker
+        is open (or its last dispatch rode the degraded ladder), the bulk
+        lane sheds so remaining capacity serves interactive traffic."""
+        if lane != BULK or not self.shed_bulk_when_degraded:
+            return None
+        entry = self._source.peek()
+        runner = getattr(entry, "runner", None)
+        breaker = getattr(runner, "breaker", None)
+        state = breaker.state if breaker is not None else "closed"
+        if state == "open" or getattr(runner, "_degraded_mode", False):
+            return "degraded"
+        return None
+
+    def _on_queue_change(self, depth: int, queued_rows: int) -> None:
+        REGISTRY.set_gauge("langdetect_serve_queue_depth", depth)
+        REGISTRY.set_gauge("langdetect_serve_queue_rows", queued_rows)
+
+    # Live knob views onto the core queue (settable at runtime: the next
+    # admission / flush decision sees the new value).
+    @property
+    def max_rows(self) -> int:
+        return self._queue.max_rows
+
+    @max_rows.setter
+    def max_rows(self, value: int) -> None:
+        self._queue.max_rows = int(value)
+
+    @property
+    def max_wait_s(self) -> float:
+        return self._queue.max_wait_s
+
+    @max_wait_s.setter
+    def max_wait_s(self, value: float) -> None:
+        self._queue.max_wait_s = float(value)
+
+    @property
+    def max_queue_rows(self) -> int:
+        return self._queue.max_queue_rows
+
+    @max_queue_rows.setter
+    def max_queue_rows(self, value: int) -> None:
+        self._queue.max_queue_rows = int(value)
+
+    @property
+    def slo_s(self) -> float:
+        return self._queue.slo_s
+
+    @slo_s.setter
+    def slo_s(self, value: float) -> None:
+        self._queue.slo_s = float(value)
+
+    @property
+    def _ema_rows_per_s(self) -> float:
+        return self._queue.ema_rows_per_s
+
+    @_ema_rows_per_s.setter
+    def _ema_rows_per_s(self, value: float) -> None:
+        self._queue.ema_rows_per_s = float(value)
 
     # ------------------------------------------------------- admission ------
     def submit(
@@ -264,7 +317,7 @@ class ContinuousBatcher:
             ) from e
         tid = trace_id or current_trace_id() or new_trace_id()
         if not docs:
-            if self._closed:
+            if self._queue.closed:
                 raise ServeClosed(f"batcher {self.name!r} is closed")
             # Zero-row requests never wake the row-counting dispatcher;
             # answer them at admission with the empty result the runner
@@ -296,22 +349,20 @@ class ContinuousBatcher:
             trace_id=tid,
             admitted_at=now,
         )
-        with self._cv:
-            if self._closed:
-                raise ServeClosed(f"batcher {self.name!r} is closed")
-            reason, wait_s = self._shed_reason_locked(len(docs), priority)
-            if reason is not None:
-                self._count_shed(len(docs), reason, priority)
-                raise ServeOverloaded(
-                    f"request shed ({reason}): {self._queued_rows} rows "
-                    f"queued, estimated wait {wait_s * 1e3:.1f}ms",
-                    reason=reason,
-                    retry_after_s=max(wait_s, self.max_wait_s),
-                )
-            self._lanes[priority].append(req)
-            self._queued_rows += len(docs)
-            self._set_queue_gauges_locked()
-            self._cv.notify_all()
+        # Admission is one atomic core call: closed check, queue bound,
+        # SLO estimate, and the degraded-bulk probe all under the queue
+        # lock (exec.core.AdmissionQueue) — reject-newest, never evict.
+        reason, wait_s = self._queue.admit(req, len(docs), priority)
+        if reason == "closed":
+            raise ServeClosed(f"batcher {self.name!r} is closed")
+        if reason is not None:
+            self._count_shed(len(docs), reason, priority)
+            raise ServeOverloaded(
+                f"request shed ({reason}): {self._queue.queued_rows} rows "
+                f"queued, estimated wait {wait_s * 1e3:.1f}ms",
+                reason=reason,
+                retry_after_s=max(wait_s, self.max_wait_s),
+            )
         REGISTRY.incr("serve/admitted_requests")
         return req.future
 
@@ -323,42 +374,14 @@ class ContinuousBatcher:
         """Blocking convenience: admit + wait; int32 [N] argmax ids."""
         return self.submit(byte_docs, want_labels=True, **kw).result().values
 
-    def _shed_reason_locked(
-        self, rows: int, priority: str
-    ) -> tuple[str | None, float]:
-        """(shed reason or None, estimated wait seconds). Caller holds
-        the lock. Reject-newest: the request being admitted is the one
-        shed — already-queued work is never evicted."""
-        backlog = self._queued_rows + self._inflight_rows
-        wait_s = (
-            backlog / self._ema_rows_per_s if self._ema_rows_per_s > 0 else 0.0
-        )
-        if self._queued_rows + rows > self.max_queue_rows:
-            return "queue_full", wait_s
-        if self.slo_s > 0 and wait_s > self.slo_s:
-            return "slo", wait_s
-        if priority == BULK and self.shed_bulk_when_degraded:
-            entry = self._source.peek()
-            runner = getattr(entry, "runner", None)
-            breaker = getattr(runner, "breaker", None)
-            state = breaker.state if breaker is not None else "closed"
-            if state == "open" or getattr(runner, "_degraded_mode", False):
-                return "degraded", wait_s
-        return None, wait_s
-
     def _count_shed(self, rows: int, reason: str, priority: str) -> None:
         REGISTRY.incr("serve/shed_requests")
         REGISTRY.incr("serve/shed_rows", rows)
         REGISTRY.incr(f"serve/shed_{reason}")
         log_event(
             _log, "serve.shed", reason=reason, rows=rows, priority=priority,
-            queued_rows=self._queued_rows, trace_id=current_trace_id(),
+            queued_rows=self._queue.queued_rows, trace_id=current_trace_id(),
         )
-
-    def _set_queue_gauges_locked(self) -> None:
-        depth = sum(len(lane) for lane in self._lanes.values())
-        REGISTRY.set_gauge("langdetect_serve_queue_depth", depth)
-        REGISTRY.set_gauge("langdetect_serve_queue_rows", self._queued_rows)
 
     # ------------------------------------------------------- dispatcher -----
     @staticmethod
@@ -379,57 +402,15 @@ class ContinuousBatcher:
         except BaseException:
             REGISTRY.incr("serve/cancelled_requests")
 
-    def _oldest_locked(self) -> float | None:
-        ages = [
-            lane[0].admitted_at for lane in self._lanes.values() if lane
-        ]
-        return min(ages) if ages else None
-
-    def _take_locked(self) -> list[_Request]:
-        """Pop one coalesced batch: interactive lane first, then bulk,
-        whole requests only, until ``max_rows`` is reached (the first
-        request is always taken, even when larger than ``max_rows``).
-        All requests in a batch share one result mode — a mode flip at a
-        lane front ends the batch there (it leads the next one), so the
-        demux below stays a pure offset walk."""
-        batch: list[_Request] = []
-        rows = 0
-        want_labels: bool | None = None
-        for lane in LANES:
-            q = self._lanes[lane]
-            while q and (rows < self.max_rows or not batch):
-                if want_labels is not None and q[0].want_labels != want_labels:
-                    break
-                req = q.popleft()
-                want_labels = req.want_labels
-                batch.append(req)
-                rows += len(req.docs)
-        self._queued_rows -= rows
-        self._inflight_rows = rows
-        self._set_queue_gauges_locked()
-        return batch
-
     def _run(self) -> None:
+        # The flush-window wait, lane priority, and whole-request
+        # coalescing all live in the core queue; requests in one batch
+        # share a result mode (the key) — a mode flip at a lane front ends
+        # the batch there, so the demux below stays a pure offset walk.
         while True:
-            with self._cv:
-                while self._queued_rows == 0 and not self._closed:
-                    self._cv.wait()
-                if self._queued_rows == 0 and self._closed:
-                    return
-                # Coalescing window: hold the flush until max_rows are
-                # queued or the oldest request has waited max_wait — the
-                # micro-batch analog of Nagle, bounded by the SLO knob.
-                while self._queued_rows < self.max_rows:
-                    oldest = self._oldest_locked()
-                    if oldest is None:
-                        break
-                    remaining = oldest + self.max_wait_s - time.monotonic()
-                    if remaining <= 0 or self._closed:
-                        break
-                    self._cv.wait(remaining)
-                if self._queued_rows == 0:
-                    continue
-                batch = self._take_locked()
+            batch = self._queue.next_batch(key=lambda r: r.want_labels)
+            if batch is None:
+                return
             try:
                 self._dispatch(batch)
             except Exception as e:  # safety net: the thread must survive
@@ -439,9 +420,7 @@ class ContinuousBatcher:
                         f"internal dispatcher error: {e!r}"
                     ))
             finally:
-                with self._cv:
-                    self._inflight_rows = 0
-                    self._cv.notify_all()
+                self._queue.done()
 
     def _dispatch(self, batch: list[_Request]) -> None:
         t_start = time.monotonic()
@@ -506,12 +485,17 @@ class ContinuousBatcher:
         REGISTRY.observe("serve/rows_per_dispatch", rows)
         REGISTRY.observe("serve/requests_per_dispatch", len(live))
         REGISTRY.observe("serve/dispatch_s", dispatch_s)
-        if dispatch_s > 0:
-            rate = rows / dispatch_s
-            self._ema_rows_per_s = (
-                rate if self._ema_rows_per_s == 0.0
-                else 0.7 * self._ema_rows_per_s + 0.3 * rate
-            )
+        # Serve-path fill: how full each dispatched batch ran against the
+        # coalescing bound (the serving analog of score/batch_fill_ratio —
+        # telemetry/compare regresses fill down / waste up, and the tuner
+        # reads the aggregate counters). A single over-bound request
+        # counts as full, never as negative waste.
+        capacity = max(self.max_rows, rows)
+        fill = rows / capacity if capacity else 1.0
+        REGISTRY.observe("serve/fill_ratio", fill)
+        REGISTRY.observe("serve/padding_waste", 1.0 - fill)
+        REGISTRY.incr("serve/dispatch_capacity_rows", capacity)
+        self._queue.record_rate(rows, dispatch_s)
         done = time.monotonic()
         off = 0
         for req in live:
@@ -537,35 +521,16 @@ class ContinuousBatcher:
     # ------------------------------------------------------------ admin -----
     def stats(self) -> dict:
         """Queue/backpressure snapshot for /healthz."""
-        with self._lock:
-            return {
-                "queue_depth": sum(len(q) for q in self._lanes.values()),
-                "queued_rows": self._queued_rows,
-                "inflight_rows": self._inflight_rows,
-                "ema_rows_per_s": round(self._ema_rows_per_s, 3),
-                "max_rows": self.max_rows,
-                "max_wait_ms": self.max_wait_s * 1e3,
-                "max_queue_rows": self.max_queue_rows,
-                "slo_ms": self.slo_s * 1e3,
-                "closed": self._closed,
-            }
+        return self._queue.stats()
 
     def close(self, drain: bool = True) -> None:
         """Stop admitting; by default drain queued requests first so no
         admitted request is ever dropped. With ``drain=False`` queued
         requests fail with :class:`ServeClosed` (still never a hang)."""
-        with self._cv:
-            self._closed = True
-            if not drain:
-                for lane in self._lanes.values():
-                    while lane:
-                        req = lane.popleft()
-                        self._queued_rows -= len(req.docs)
-                        self._complete(req, error=ServeClosed(
-                            f"batcher {self.name!r} closed"
-                        ))
-                self._set_queue_gauges_locked()
-            self._cv.notify_all()
+        for req in self._queue.close(drain=drain):
+            self._complete(req, error=ServeClosed(
+                f"batcher {self.name!r} closed"
+            ))
         self._thread.join(timeout=30.0)
         log_event(_log, "serve.batcher.close", drained=drain)
 

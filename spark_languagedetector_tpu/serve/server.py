@@ -33,6 +33,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..exec import config as exec_config
 from ..ops.encoding import UTF8, text_to_bytes
 from ..telemetry import REGISTRY
 from ..utils.logging import get_logger, log_event
@@ -284,6 +285,12 @@ class ServingServer(ThreadingHTTPServer):
                 self.registry.versions()
                 if hasattr(self.registry, "versions") else []
             ),
+            # The audited effective config: every LANGDETECT_* knob's live
+            # value and provenance (explicit/env/profile/default), plus
+            # the active tuning profile and the deprecation table — "which
+            # knob is actually driving this deployment" answered from one
+            # endpoint (docs/PERFORMANCE.md §9).
+            "config": exec_config.effective_config(),
         }
 
 

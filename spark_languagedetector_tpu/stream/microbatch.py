@@ -30,12 +30,13 @@ Streaming provided for free:
 from __future__ import annotations
 
 import time
-from collections import deque
-from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
+from itertools import islice
 from typing import Callable, Iterable, Iterator, Sequence
 
 from ..api.table import Table
+from ..exec import config as exec_config
+from ..exec.core import ordered_prefetch
 from ..resilience import faults
 from ..resilience.dlq import DeadLetterQueue
 from ..resilience.policy import CircuitBreaker, RetryPolicy
@@ -134,7 +135,7 @@ def run_stream(
     *,
     max_batches: int | None = None,
     on_progress: Callable[[StreamingQuery], None] | None = None,
-    prefetch: int = 0,
+    prefetch: int | None = None,
     workers: int | None = None,
     retry_policy: RetryPolicy | None = None,
     breaker: CircuitBreaker | None = None,
@@ -174,8 +175,12 @@ def run_stream(
     runner's own breaker; see docs/RESILIENCE.md §6).
 
     ``prefetch > 0`` overlaps batch N+1's transform with batch N's result
-    fetch and sink; sinks always run in the caller's thread, in source
-    order. ``workers`` (default ``min(2, prefetch)``) is the transform
+    fetch and sink via the execution core's ordered pipeline
+    (``exec.core.ordered_prefetch`` — the same machinery under the fit
+    ingest); sinks always run in the caller's thread, in source order.
+    ``prefetch``/``workers`` left ``None`` resolve through ``exec.config``
+    (env ``LANGDETECT_STREAM_PREFETCH`` / ``LANGDETECT_STREAM_WORKERS``;
+    defaults 0 and ``min(2, prefetch)``). ``workers`` is the transform
     concurrency: with one worker, transforms serialize — batch N+1's
     host->device transfer cannot start until batch N's result fetch
     returns, which on a high-latency link (tunneled TPU here) leaves the
@@ -192,6 +197,9 @@ def run_stream(
     it = iter(source)
     policy = retry_policy if retry_policy is not None else RetryPolicy.from_env()
     input_col = getattr(model, "get_input_col", lambda: None)()
+    prefetch = int(exec_config.resolve("stream_prefetch", prefetch))
+    if workers is None:
+        workers = exec_config.resolve("stream_workers")
 
     # Resume: fast-forward past batches a previous run already committed.
     committed = 0
@@ -293,120 +301,118 @@ def run_stream(
             settle(tbl, seq, 0, error)  # nests as stream/batch/quarantine
 
     n_workers = workers if workers is not None else min(2, max(prefetch, 1))
-    executor = (
-        ThreadPoolExecutor(max_workers=n_workers) if prefetch > 0 else None
-    )
-    in_flight: deque = deque()  # (batch, seq, trace_id, future-or-None)
-    seq = committed
+    seq_box = [committed]
+
+    def pulled() -> Iterator[tuple[Table, int, str]]:
+        """Source batches with the per-pull work stamped at pull time:
+        chaos row corruption (deterministic per batch count), the batch's
+        trace id, and its sequence number. The execution core's pipeline
+        pulls from this lazily — at most ``prefetch + 1`` ahead of the
+        drain — so a consuming source (Kafka auto-commit) never loses
+        more than the pipeline depth on a crash."""
+        while True:
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            batch, _ = faults.corrupt_batch(batch, input_col)
+            tid = new_trace_id()
+            s = seq_box[0]
+            seq_box[0] += 1
+            yield batch, s, tid
+
+    # Budget BEFORE pulling: total pulls (drained + in flight) never
+    # exceed max_batches, so an over-pulled batch is never silently lost.
+    src_iter: Iterable = pulled()
+    if max_batches is not None:
+        src_iter = islice(src_iter, max(0, max_batches))
+    pipeline = None
     try:
         with span(
             "stream", prefetch=prefetch, workers=n_workers
         ) as stream_span:
-            while True:
-                # Check the budget BEFORE pulling: a source like Kafka
-                # consumes (and may auto-commit) records on next(), so an
-                # over-pulled batch would be silently lost.
-                want_more = (
-                    max_batches is None
-                    or query.batches + len(in_flight) < max_batches
-                )
-                batch = None
-                if want_more:
+            # The shared ordered pipeline (exec.core): transforms run on
+            # worker threads up to ``prefetch`` batches ahead, results
+            # drain in source order; prefetch=0 keeps the synchronous
+            # semantics (the thunk transforms inline, in this thread).
+            pipeline = ordered_prefetch(
+                src_iter,
+                lambda item: transform_once(*item),
+                depth=prefetch,
+                workers=n_workers,
+            )
+            for (src, src_seq, src_tid), thunk, prefetched, pending in pipeline:
+                REGISTRY.observe("stream/queue_depth", pending)
+                REGISTRY.set_gauge("stream/queue_depth", pending)
+                t0 = time.perf_counter()
+                # The timer covers processing (transform-or-wait + sink)
+                # only, never idle source polling, matching the synchronous
+                # loop's throughput semantics.
+                with trace_request(src_tid), query.metrics.timer(
+                    "total_s"
+                ), span(
+                    "stream/batch", batch=src_seq, rows=src.num_rows
+                ):
                     try:
-                        batch = next(it)
-                    except StopIteration:
-                        want_more = False
-                if batch is not None:
-                    # Chaos hook: a plan with a poison spec corrupts rows
-                    # of this source batch (deterministic per batch count).
-                    batch, _ = faults.corrupt_batch(batch, input_col)
-                    # Each source batch is one request: its trace id is
-                    # minted at pull time and travels with the batch
-                    # through the prefetch worker and the drain loop.
-                    tid = new_trace_id()
-                    fut = (
-                        None
-                        if executor is None
-                        else executor.submit(transform_once, batch, seq, tid)
-                    )
-                    in_flight.append((batch, seq, tid, fut))
-                    seq += 1
-                if not in_flight:
-                    break
-                # Drain when the pipeline is full or the source is done. The
-                # timer covers processing (transform-or-wait + sink) only,
-                # never idle source polling, matching the synchronous loop's
-                # throughput semantics.
-                if len(in_flight) > prefetch or not want_more or batch is None:
-                    REGISTRY.observe("stream/queue_depth", len(in_flight))
-                    REGISTRY.set_gauge("stream/queue_depth", len(in_flight))
-                    src, src_seq, src_tid, fut = in_flight.popleft()
-                    t0 = time.perf_counter()
-                    with trace_request(src_tid), query.metrics.timer(
-                        "total_s"
-                    ), span(
-                        "stream/batch", batch=src_seq, rows=src.num_rows
-                    ):
-                        try:
-                            if fut is None:
-                                out = transform_once(src, src_seq, src_tid)
-                            else:
-                                # Sink-visible stall: how long the drain sat
-                                # waiting on the prefetch worker — the signal
-                                # separating "wire is behind" from "sink is
-                                # behind" when stream throughput drops.
-                                t_wait = time.perf_counter()
-                                out = fut.result()
-                                REGISTRY.observe(
-                                    "stream/prefetch_stall_s",
-                                    time.perf_counter() - t_wait,
-                                )
-                        except Exception as e:
-                            # Retryable errors already exhausted the policy
-                            # inside transform_once; what reaches here is
-                            # either deterministic (→ quarantine when a DLQ
-                            # is wired) or a device outage the runner's
-                            # degraded ladder could not absorb (→ propagate:
-                            # quarantining healthy data during an outage
-                            # would turn downtime into data loss).
-                            if dlq is None or policy.classify(e):
-                                raise
-                            quarantine(src, src_seq, src_tid, e)
+                        if not prefetched:
+                            out = thunk()
                         else:
-                            with span("sink", rows=src.num_rows):
-                                sink(out)  # nests as stream/batch/sink
-                    dt = time.perf_counter() - t0
-                    query.batches += 1
-                    query.rows += src.num_rows
-                    query.last_batch_rows = src.num_rows
-                    query.last_batch_seconds = dt
-                    query.last_batch_trace_id = src_tid
-                    query.metrics.incr("rows", src.num_rows)
-                    query.metrics.incr("batches")
-                    if checkpoint_path is not None:
-                        # Commit AFTER the sink (or quarantine) settled the
-                        # batch: the resume token only ever names batches
-                        # whose effects are fully externalized.
-                        from ..persist.checkpoint import save_checkpoint
+                            # Sink-visible stall: how long the drain sat
+                            # waiting on the prefetch worker — the signal
+                            # separating "wire is behind" from "sink is
+                            # behind" when stream throughput drops.
+                            t_wait = time.perf_counter()
+                            out = thunk()
+                            REGISTRY.observe(
+                                "stream/prefetch_stall_s",
+                                time.perf_counter() - t_wait,
+                            )
+                    except Exception as e:
+                        # Retryable errors already exhausted the policy
+                        # inside transform_once; what reaches here is
+                        # either deterministic (→ quarantine when a DLQ
+                        # is wired) or a device outage the runner's
+                        # degraded ladder could not absorb (→ propagate:
+                        # quarantining healthy data during an outage
+                        # would turn downtime into data loss).
+                        if dlq is None or policy.classify(e):
+                            raise
+                        quarantine(src, src_seq, src_tid, e)
+                    else:
+                        with span("sink", rows=src.num_rows):
+                            sink(out)  # nests as stream/batch/sink
+                dt = time.perf_counter() - t0
+                query.batches += 1
+                query.rows += src.num_rows
+                query.last_batch_rows = src.num_rows
+                query.last_batch_seconds = dt
+                query.last_batch_trace_id = src_tid
+                query.metrics.incr("rows", src.num_rows)
+                query.metrics.incr("batches")
+                if checkpoint_path is not None:
+                    # Commit AFTER the sink (or quarantine) settled the
+                    # batch: the resume token only ever names batches
+                    # whose effects are fully externalized.
+                    from ..persist.checkpoint import save_checkpoint
 
-                        save_checkpoint(
-                            checkpoint_path,
-                            {
-                                "committed": src_seq + 1,
-                                "rows": query.rows,
-                                "dlq_rows": query.dlq_rows,
-                            },
-                        )
-                    if on_progress is not None:
-                        on_progress(query)
-                    log_event(
-                        _log,
-                        "stream.batch",
-                        n=query.batches,
-                        rows=src.num_rows,
-                        seconds=dt,
-                        trace_id=src_tid,
+                    save_checkpoint(
+                        checkpoint_path,
+                        {
+                            "committed": src_seq + 1,
+                            "rows": query.rows,
+                            "dlq_rows": query.dlq_rows,
+                        },
                     )
+                if on_progress is not None:
+                    on_progress(query)
+                log_event(
+                    _log,
+                    "stream.batch",
+                    n=query.batches,
+                    rows=src.num_rows,
+                    seconds=dt,
+                    trace_id=src_tid,
+                )
     except Exception as e:
         # Post-mortem: dump the flight-recorder ring (when armed) before
         # the loop unwinds — a consuming source may make this failure
@@ -414,7 +420,8 @@ def run_stream(
         flightrec.record_crash("stream", e)
         raise
     finally:
-        if executor is not None:
-            # Don't wait for transforms of batches this run will never sink.
-            executor.shutdown(wait=True, cancel_futures=True)
+        if pipeline is not None:
+            # Don't wait for transforms of batches this run will never
+            # sink; closing the pipeline cancels them and joins the pool.
+            pipeline.close()
     return query

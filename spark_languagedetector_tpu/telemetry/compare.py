@@ -37,7 +37,11 @@ DEFAULT_THRESHOLD = 0.25
 DEFAULT_METRICS = ("p50", "p90", "p99", "device_total_s", "device_p99")
 
 # Snapshot histograms where *higher* is better; everything else (stall
-# seconds, latency, padding waste, retries) regresses upward.
+# seconds, latency, padding waste, retries) regresses upward. Matches by
+# substring, so the serve path's coalescing health rides automatically:
+# ``serve/fill_ratio`` regresses when it *drops* (emptier dispatches) and
+# ``serve/padding_waste`` when it *rises* — the two sides of the padding
+# tax docs/PERFORMANCE.md §9 describes, pinned by tests/test_exec.py.
 _HIGHER_BETTER = ("fill_ratio",)
 
 # Tracked gauges (last snapshot): byte-traffic contract metrics, keyed to
@@ -52,6 +56,22 @@ _TRACKED_GAUGES = {
     "langdetect_fit_collect_bytes": "fit_collect_bytes",
 }
 
+# Aggregate fill-ratio contract metrics re-derived from the last
+# snapshot's exact byte/row counters (the per-batch histograms are sampled
+# reservoirs; these are whole-run truth): real bytes over capacity bytes
+# for each wire path, coalesced rows over dispatch capacity for serving.
+# Names carry "fill_ratio" so the tracked diff treats them higher-better —
+# a change that quietly unfills the compiled shapes (lattice drift, a
+# mis-tuned profile, a coalescing regression) fails here even when every
+# latency percentile held steady.
+_TRACKED_RATIOS = {
+    "fill_ratio[score/wire]": ("score/real_bytes", "score/capacity_bytes"),
+    "fill_ratio[fit/wire]": ("fit/real_bytes", "fit/capacity_bytes"),
+    "fill_ratio[serve/coalesce]": (
+        "serve/coalesced_rows", "serve/dispatch_capacity_rows"
+    ),
+}
+
 
 def _tracked_metrics(events: list[dict], stages: dict) -> dict[str, float]:
     """Gauge-derived contract metrics from a capture's LAST snapshot.
@@ -64,13 +84,25 @@ def _tracked_metrics(events: list[dict], stages: dict) -> dict[str, float]:
     block reports.
     """
     gauges: dict = {}
+    counters: dict = {}
     for ev in events:
         if ev.get("event") != "telemetry.snapshot":
             continue
         payload = ev.get("gauges")
         if isinstance(payload, dict):
             gauges = payload
+        cpayload = ev.get("counters")
+        if isinstance(cpayload, dict):
+            counters = cpayload
     out: dict[str, float] = {}
+    for name, (num_key, den_key) in _TRACKED_RATIOS.items():
+        num, den = counters.get(num_key), counters.get(den_key)
+        if (
+            isinstance(num, (int, float))
+            and isinstance(den, (int, float))
+            and den > 0
+        ):
+            out[name] = round(float(num) / float(den), 6)
     for name, short in _TRACKED_GAUGES.items():
         series = gauges.get(name)
         if not isinstance(series, dict):
@@ -288,11 +320,12 @@ def compare_captures(
                 f"{nv:>12.6f} {shown}{flag}"
             )
 
-    # Tracked table-traffic gauges: upward movement past threshold is a
-    # regression (more table bytes resident / streamed, more of the HBM
-    # roof consumed). Unlike the recovery counters, a metric appearing in
-    # only one capture is informational — instrumentation grows between
-    # rounds, and a freshly-tracked gauge has no contract yet.
+    # Tracked contract metrics: table-traffic gauges regress upward (more
+    # table bytes resident / streamed, more of the HBM roof consumed);
+    # the aggregate fill ratios regress downward (emptier shapes). Unlike
+    # the recovery counters, a metric appearing in only one capture is
+    # informational — instrumentation grows between rounds, and a
+    # freshly-tracked metric has no contract yet.
     b_t, n_t = base.get("tracked", {}), new.get("tracked", {})
     for name in sorted(set(b_t) | set(n_t)):
         if name not in b_t or name not in n_t:
@@ -304,11 +337,13 @@ def compare_captures(
         delta = _rel_delta(b_t[name], n_t[name])
         if delta is None:
             continue
+        higher_better = any(t in name for t in _HIGHER_BETTER)
+        worse = -delta if higher_better else delta
         flag = ""
-        if delta > threshold:
+        if worse > threshold:
             flag = "  REGRESSION"
             regressions.append(
-                f"{name}: {b_t[name]:g} -> {n_t[name]:g} (+{delta:.1%})"
+                f"{name}: {b_t[name]:g} -> {n_t[name]:g} ({delta:+.1%})"
             )
         if flag or abs(delta) > threshold / 2:
             lines.append(

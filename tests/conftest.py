@@ -35,3 +35,11 @@ def eight_devices():
     devices = jax.devices()
     assert len(devices) >= 8, f"expected 8 virtual devices, got {devices}"
     return devices
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-second end-to-end legs excluded from the tier-1 run "
+        "(-m 'not slow'); exercised by their bench smoke gates instead",
+    )

@@ -1,0 +1,664 @@
+"""Execution core + config + autotuner (ISSUE 8).
+
+The acceptance contract: one scheduler/executor core under all three front
+ends with bit-exact parity to the pre-refactor paths on the
+geometry-stable gather strategy (matmul strategies stay labels-exact per
+the ARCHITECTURE.md reduction-order class), chaos plans replaying through
+the shared retry/degrade wiring at the existing fault sites, one audited
+config module resolving every LANGDETECT_* knob, and a deterministic
+offline tuner whose profile the runner/stream/serve load at startup.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from spark_languagedetector_tpu import LanguageDetector, Table
+from spark_languagedetector_tpu.api.runner import BatchRunner
+from spark_languagedetector_tpu.exec import config as exec_config
+from spark_languagedetector_tpu.exec import core, tune
+from spark_languagedetector_tpu.exec.profile import (
+    TuningProfile,
+    content_version,
+)
+from spark_languagedetector_tpu.models.profile import GramProfile
+from spark_languagedetector_tpu.ops import encoding
+from spark_languagedetector_tpu.ops.encoding import bucket_length
+from spark_languagedetector_tpu.resilience.faults import FaultPlan, plan_scope
+from spark_languagedetector_tpu.resilience.policy import (
+    CircuitBreaker,
+    RetryPolicy,
+)
+from spark_languagedetector_tpu.serve import ContinuousBatcher
+from spark_languagedetector_tpu.stream.microbatch import (
+    memory_source,
+    run_stream,
+)
+from spark_languagedetector_tpu.telemetry import REGISTRY
+from spark_languagedetector_tpu.telemetry.compare import (
+    capture_stats,
+    compare_captures,
+)
+
+LANGS = ("x", "y", "z")
+GRAM_MAP = {
+    b"ab": [1.0, 0.0, 0.2],
+    b"bc": [0.5, 0.5, 0.0],
+    b"zz": [0.0, 2.0, 0.1],
+    b"qx": [0.1, 0.0, 3.0],
+}
+
+
+def _runner(**kw):
+    profile = GramProfile.from_gram_map(GRAM_MAP, LANGS, (2,))
+    weights, lut = profile.device_arrays()
+    kw.setdefault("strategy", "gather")
+    return BatchRunner(weights=weights, lut=lut, spec=profile.spec, **kw)
+
+
+def _docs(rng, n, max_len=200):
+    return [
+        bytes(rng.integers(97, 123, rng.integers(0, max_len)).tolist())
+        for _ in range(n)
+    ]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_profile_cache():
+    exec_config.reload_profile()
+    yield
+    exec_config.reload_profile()
+
+
+# ------------------------------------------------------------ core: plan ----
+def _reference_plan(sizes, length_buckets, rows_for, order=None):
+    """The pre-refactor planning algorithm, verbatim (runner + fit both
+    carried a copy): bucket grouping in iteration order, per-bucket full
+    batches, remainder carried into the next wider bucket, one tail."""
+    idx_iter = range(len(sizes)) if order is None else order
+    by_bucket = {}
+    for i in idx_iter:
+        b = bucket_length(sizes[i] or 1, length_buckets)
+        by_bucket.setdefault(b, []).append(int(i))
+    plan, carry = [], []
+    for pad_to in sorted(by_bucket):
+        idxs = carry + by_bucket[pad_to]
+        rows = rows_for(pad_to)
+        full_end = len(idxs) - len(idxs) % rows
+        for start in range(0, full_end, rows):
+            plan.append((idxs[start:start + rows], pad_to))
+        carry = idxs[full_end:]
+    if carry:
+        pad_to = bucket_length(
+            max(sizes[i] for i in carry) or 1, length_buckets
+        )
+        rows = rows_for(pad_to)
+        for start in range(0, len(carry), rows):
+            plan.append((carry[start:start + rows], pad_to))
+    return plan
+
+
+def test_plan_micro_batches_matches_pre_refactor_reference_fuzz():
+    rng = np.random.default_rng(3)
+    buckets = (64, 128, 512, 1024)
+    for trial in range(30):
+        n = int(rng.integers(0, 200))
+        sizes = [int(s) for s in rng.integers(0, 1400, n)]
+        rows_for = lambda p: core.rows_under_byte_budget(  # noqa: E731
+            p, 16 << 10, 32, 4
+        )
+        order = None
+        if trial % 2:
+            order = np.argsort(sizes, kind="stable")
+        got = core.plan_micro_batches(
+            sizes, length_buckets=buckets, rows_for=rows_for, order=order
+        )
+        want = _reference_plan(sizes, buckets, rows_for, order=order)
+        assert len(got) == len(want)
+        for (gsel, gpad), (wsel, wpad) in zip(got, want):
+            assert gpad == wpad
+            assert list(gsel) == list(wsel)
+        # Every item planned exactly once.
+        planned = [int(i) for sel, _ in got for i in sel]
+        assert sorted(planned) == list(range(n))
+
+
+def test_rows_under_byte_budget_halves_to_floor_and_legacy_alias():
+    assert core.rows_under_byte_budget(2048, 8 << 20, 4096) == 4096
+    assert core.rows_under_byte_budget(8192, 8 << 20, 4096) == 1024
+    assert core.rows_under_byte_budget(1 << 30, 8 << 20, 4096, 64) == 64
+    # ops.encoding keeps the old import surface, delegating to the core.
+    for pad_to in (128, 2048, 8192):
+        assert encoding.rows_under_byte_budget(
+            pad_to, 8 << 20, 4096
+        ) == core.rows_under_byte_budget(pad_to, 8 << 20, 4096)
+
+
+# -------------------------------------------------- core: ordered prefetch --
+def test_ordered_prefetch_orders_results_and_bounds_pulls():
+    pulled = []
+
+    def src():
+        for i in range(20):
+            pulled.append(i)
+            yield i
+
+    done = []
+    out = []
+    for item, thunk, prefetched, pending in core.ordered_prefetch(
+        src(), lambda i: i * i, depth=3, workers=2
+    ):
+        assert pending >= 1
+        # Bounded pulls: never more than depth+1 ahead of the drain.
+        assert len(pulled) - len(done) <= 4
+        out.append(thunk())
+        done.append(item)
+    assert out == [i * i for i in range(20)]
+    assert done == list(range(20))
+
+
+def test_ordered_prefetch_depth_zero_runs_inline():
+    ran_in = []
+
+    def fn(i):
+        ran_in.append(threading.current_thread())
+        return i + 1
+
+    results = []
+    for _, thunk, prefetched, pending in core.ordered_prefetch(
+        range(5), fn, depth=0
+    ):
+        assert prefetched is False and pending == 1
+        assert not ran_in or ran_in[-1] is threading.current_thread()
+        results.append(thunk())
+    assert results == [1, 2, 3, 4, 5]
+    assert all(t is threading.current_thread() for t in ran_in)
+
+
+def test_ordered_prefetch_surfaces_error_at_the_failing_item():
+    def fn(i):
+        if i == 3:
+            raise RuntimeError("boom3")
+        return i
+
+    seen = []
+    with pytest.raises(RuntimeError, match="boom3"):
+        for item, thunk, _, _ in core.ordered_prefetch(
+            range(6), fn, depth=2, workers=2
+        ):
+            seen.append(thunk())
+    assert seen == [0, 1, 2]  # everything before the poison item drained
+
+
+def test_ordered_prefetch_close_stops_worker():
+    started = []
+    gen = core.ordered_prefetch(
+        range(100), lambda i: started.append(i) or i, depth=2, workers=1
+    )
+    first = next(gen)
+    assert first[1]() == 0
+    gen.close()  # must cancel pending work and join the pool
+    assert len(started) <= 5
+
+
+# ------------------------------------------------ core: guarded dispatch ----
+def test_guarded_dispatch_fast_path_and_recovered_hook():
+    policy = RetryPolicy(max_attempts=2, base_delay_s=0.0)
+    recovered = []
+    out = core.guarded_dispatch(
+        lambda: 41,
+        policy=policy,
+        site="score/dispatch",
+        breaker=CircuitBreaker(name="t"),
+        degraded=lambda cause: pytest.fail("degraded must not run"),
+        on_recovered=lambda: recovered.append(1),
+    )
+    assert out == 41 and recovered == [1]
+
+
+def test_guarded_dispatch_falls_to_ladder_with_cause_and_raises_deterministic():
+    policy = RetryPolicy(max_attempts=1, base_delay_s=0.0)
+    causes = []
+
+    def fast():
+        raise RuntimeError("transient")
+
+    out = core.guarded_dispatch(
+        fast,
+        policy=policy,
+        site="score/dispatch",
+        breaker=CircuitBreaker(name="t2"),
+        degraded=lambda cause: causes.append(cause) or "degraded",
+    )
+    assert out == "degraded"
+    assert isinstance(causes[0], RuntimeError)
+    with pytest.raises(ValueError):
+        core.guarded_dispatch(
+            lambda: (_ for _ in ()).throw(ValueError("det")),
+            policy=policy,
+            site="score/dispatch",
+            breaker=CircuitBreaker(name="t3"),
+            degraded=lambda cause: pytest.fail("deterministic must raise"),
+        )
+
+
+def test_guarded_dispatch_open_breaker_short_circuits():
+    clock = [0.0]
+    breaker = CircuitBreaker(
+        1, 1000.0, name="t4", clock=lambda: clock[0],
+    )
+    breaker.record_failure()
+    assert breaker.state == "open"
+    policy = RetryPolicy(max_attempts=1, base_delay_s=0.0)
+    before = REGISTRY.counters.get("resilience/breaker_short_circuit", 0)
+    out = core.guarded_dispatch(
+        lambda: pytest.fail("fast path must not run while open"),
+        policy=policy,
+        site="score/dispatch",
+        breaker=breaker,
+        degraded=lambda cause: "ladder",
+    )
+    assert out == "ladder"
+    assert REGISTRY.counters["resilience/breaker_short_circuit"] == before + 1
+
+
+# ------------------------------------------------- core: admission queue ----
+def test_admission_queue_lane_priority_and_key_partition():
+    q = core.AdmissionQueue(max_rows=100, max_wait_s=0.0, max_queue_rows=1000)
+    q.admit(("bulk1", True), 4, "bulk")
+    q.admit(("int1", True), 4, "interactive")
+    q.admit(("int2", False), 4, "interactive")
+    batch = q.next_batch(key=lambda item: item[1])
+    # Interactive drains first; the key flip at int2 ends the batch before
+    # it, and bulk1 (matching key) follows int1.
+    assert [x[0] for x in batch] == ["int1", "bulk1"]
+    q.done()
+    assert [x[0] for x in q.next_batch(key=lambda item: item[1])] == ["int2"]
+    q.done()
+
+
+def test_admission_queue_shed_reasons_and_close():
+    q = core.AdmissionQueue(
+        max_rows=8, max_wait_s=10.0, max_queue_rows=10,
+        shed_probe=lambda lane: "degraded" if lane == "bulk" else None,
+    )
+    assert q.admit("a", 8, "interactive") == (None, 0.0)
+    assert q.admit("b", 8, "interactive")[0] == "queue_full"
+    assert q.admit("c", 1, "bulk")[0] == "degraded"
+    q.ema_rows_per_s = 1.0  # 8 queued rows -> 8s estimated wait
+    q2 = core.AdmissionQueue(
+        max_rows=8, max_wait_s=10.0, max_queue_rows=100, slo_s=0.5,
+    )
+    q2.ema_rows_per_s = 1.0
+    q2.admit("a", 8, "interactive")
+    reason, wait = q2.admit("b", 1, "interactive")
+    assert reason == "slo" and wait == pytest.approx(8.0)
+    evicted = q.close(drain=False)
+    assert evicted == ["a"]
+    assert q.admit("d", 1, "interactive")[0] == "closed"
+    assert q.next_batch() is None
+
+
+# ----------------------------------------------------------------- config ---
+def test_config_precedence_and_type_validation(monkeypatch, tmp_path):
+    monkeypatch.delenv("LANGDETECT_BATCH_BYTES", raising=False)
+    assert exec_config.resolve("batch_bytes") == 8 << 20
+    prof = TuningProfile(tuned={"batch_bytes": 1 << 20})
+    path = tmp_path / "p.json"
+    prof.save(str(path))
+    monkeypatch.setenv(exec_config.PROFILE_ENV, str(path))
+    exec_config.reload_profile()
+    value, source = exec_config.resolve_with_source("batch_bytes")
+    assert (value, source) == (1 << 20, "profile")
+    monkeypatch.setenv("LANGDETECT_BATCH_BYTES", str(2 << 20))
+    value, source = exec_config.resolve_with_source("batch_bytes")
+    assert (value, source) == (2 << 20, "env")  # env beats profile
+    value, source = exec_config.resolve_with_source("batch_bytes", 3 << 20)
+    assert (value, source) == (3 << 20, "explicit")  # explicit beats env
+    monkeypatch.setenv("LANGDETECT_BATCH_BYTES", "not-a-number")
+    with pytest.raises(ValueError, match="LANGDETECT_BATCH_BYTES"):
+        exec_config.resolve("batch_bytes")
+    monkeypatch.setenv("LANGDETECT_BATCH_BYTES", "-5")
+    with pytest.raises(ValueError, match="positive"):
+        exec_config.resolve("batch_bytes")
+    with pytest.raises(ValueError, match="unknown config knob"):
+        exec_config.resolve("no_such_knob")
+
+
+def test_config_int_tuple_and_bool_parsing(monkeypatch):
+    monkeypatch.setenv("LANGDETECT_LENGTH_BUCKETS", "128, 256,512")
+    assert exec_config.resolve("length_buckets") == (128, 256, 512)
+    monkeypatch.setenv("LANGDETECT_LENGTH_BUCKETS", "512,128")
+    with pytest.raises(ValueError, match="ascending"):
+        exec_config.resolve("length_buckets")
+    monkeypatch.setenv("LANGDETECT_DEGRADED", "0")
+    assert exec_config.resolve("degraded") is False
+    monkeypatch.setenv("LANGDETECT_DEGRADED", "yes")
+    assert exec_config.resolve("degraded") is True
+
+
+def test_effective_config_reports_provenance_and_deprecations(
+    monkeypatch, tmp_path
+):
+    prof = TuningProfile(tuned={"serve_max_rows": 64})
+    path = tmp_path / "p.json"
+    prof.save(str(path))
+    monkeypatch.setenv(exec_config.PROFILE_ENV, str(path))
+    monkeypatch.setenv("LANGDETECT_SERVE_MAX_WAIT_MS", "7.5")
+    monkeypatch.setenv("LANGDETECT_FIT_BATCH_ROWS", "garbage")
+    exec_config.reload_profile()
+    out = exec_config.effective_config()
+    assert out["profile"]["version"] == prof.version
+    assert out["knobs"]["serve_max_rows"] == {
+        "value": 64, "source": "profile", "env": "LANGDETECT_SERVE_MAX_ROWS",
+    }
+    assert out["knobs"]["serve_max_wait_ms"]["source"] == "env"
+    assert out["knobs"]["serve_max_wait_ms"]["value"] == 7.5
+    # A malformed env var renders as an error entry instead of raising —
+    # /varz must describe the misconfiguration, not 500 on it.
+    assert "error" in out["knobs"]["fit_batch_rows"]
+    # The deprecation table names every hand-set knob the tuner replaces.
+    assert out["deprecated_env"]["LANGDETECT_SERVE_MAX_ROWS"] == (
+        "serve_max_rows"
+    )
+    assert set(out["deprecated_env"]) >= {
+        "LANGDETECT_LENGTH_BUCKETS", "LANGDETECT_BATCH_BYTES",
+        "LANGDETECT_FIT_BATCH_BYTES", "LANGDETECT_SERVE_MAX_WAIT_MS",
+        "LANGDETECT_SERVE_MAX_ROWS", "LANGDETECT_SERVE_QUEUE_ROWS",
+    }
+
+
+# ---------------------------------------------------------------- profile ---
+def test_profile_round_trip_and_validation(tmp_path):
+    prof = TuningProfile(
+        tuned={"length_buckets": [128, 384, 1024], "batch_bytes": 4 << 20},
+        source={"items": 10},
+        constraints={"max_shapes": 4},
+        created=123.0,
+    )
+    path = tmp_path / "prof.json"
+    prof.save(str(path))
+    back = TuningProfile.load(str(path))
+    assert back.tuned == prof.tuned
+    assert back.version == prof.version == content_version(prof.tuned)
+    with pytest.raises(ValueError, match="unknown tuned field"):
+        TuningProfile(tuned={"nope": 1})
+    with pytest.raises(ValueError, match="multiples of 128"):
+        TuningProfile(tuned={"length_buckets": [100, 200]})
+    with pytest.raises(ValueError, match="increasing"):
+        TuningProfile(tuned={"length_buckets": [256, 128]})
+    with pytest.raises(ValueError, match="positive"):
+        TuningProfile(tuned={"batch_bytes": 0})
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"schema": 99, "tuned": {"batch_bytes": 1}}))
+    with pytest.raises(ValueError, match="schema"):
+        TuningProfile.load(str(bad))
+
+
+# ------------------------------------------------------------------ tuner ---
+def test_solve_buckets_dp_finds_tight_lattice():
+    # 100 items at <=320B and 10 at <=1024B: two buckets suffice and the
+    # DP must place the first edge at 320 -> 384 (aligned), not at 512.
+    bins = {320: 100, 1024: 10}
+    buckets = tune.solve_buckets(bins, max_shapes=2)
+    assert buckets == [384, 1024]
+    # With one shape allowed, everything pads to the max.
+    assert tune.solve_buckets(bins, max_shapes=1) == [1024]
+    # The shape-count constraint binds: never more buckets than allowed.
+    many = {64 * i: 5 for i in range(1, 20)}
+    assert len(tune.solve_buckets(many, max_shapes=4)) <= 4
+    with pytest.raises(ValueError, match="exec/len"):
+        tune.solve_buckets({})
+
+
+def _synthetic_capture(tmp_path, counters, histograms=None, name="cap.jsonl"):
+    events = [
+        {"event": "telemetry.span", "ts": 100.0, "path": "score",
+         "wall_s": 0.5},
+        {"event": "telemetry.snapshot", "ts": 110.0, "counters": counters,
+         "gauges": {}, "histograms": histograms or {}},
+    ]
+    path = tmp_path / name
+    path.write_text("".join(json.dumps(e) + "\n" for e in events))
+    return str(path)
+
+
+def test_solve_emits_valid_profile_with_serve_fields(tmp_path):
+    counters = {
+        "exec/len/256": 500, "exec/len/320": 300, "exec/len/1024": 20,
+        "serve/coalesced_rows": 1000, "serve/dispatches": 50,
+    }
+    hists = {
+        "serve/rows_per_dispatch": {"count": 50, "mean": 20.0, "p90": 40.0},
+    }
+    path = _synthetic_capture(tmp_path, counters, hists)
+    from spark_languagedetector_tpu.telemetry.report import load_events
+
+    profile = tune.solve(load_events(path), max_shapes=4)
+    # The chunking boundary is never shrunk below the built-in top bucket
+    # (re-chunking + observation ratchet — see tune.solve); the DP's
+    # tight interior widths ride beneath it.
+    assert profile.tuned["length_buckets"][-1] == 8192
+    assert 1024 in profile.tuned["length_buckets"]
+    assert all(b % 128 == 0 for b in profile.tuned["length_buckets"])
+    assert len(profile.tuned["length_buckets"]) <= 4
+    # Unconstrained solve records NO byte budgets: defaults must keep
+    # flowing through normal config fallback, not get frozen as "tuned".
+    assert "batch_bytes" not in profile.tuned
+    assert "fit_batch_bytes" not in profile.tuned
+    assert profile.tuned["serve_max_rows"] == 64  # pow2 >= p90 rows
+    assert profile.tuned["serve_queue_rows"] == 64 * 16
+    assert 1.0 <= profile.tuned["serve_max_wait_ms"] <= 50.0
+    assert profile.created == 110.0  # capture time, not wall clock
+    # Deterministic: same capture, same profile, same version.
+    again = tune.solve(load_events(path), max_shapes=4)
+    assert again.version == profile.version
+    assert again.to_json() == profile.to_json()
+
+
+def test_tune_cli_contract(tmp_path, capsys):
+    assert tune.main([]) == 2
+    assert tune.main(["a.jsonl", "b.jsonl"]) == 2
+    assert tune.main(["--bogus", "x"]) == 2
+    assert tune.main([str(tmp_path / "missing.jsonl")]) == 2
+    empty = _synthetic_capture(tmp_path, {}, name="empty.jsonl")
+    assert tune.main([empty]) == 2  # no length signal -> loud failure
+    cap = _synthetic_capture(tmp_path, {"exec/len/256": 10})
+    out = tmp_path / "prof.json"
+    assert tune.main([cap, "-o", str(out), "--max-shapes", "3"]) == 0
+    prof = TuningProfile.load(str(out))
+    assert prof.tuned["length_buckets"] == (256, 8192)
+    text = capsys.readouterr().out
+    assert "predicted padded-byte reduction" in text
+
+
+# ----------------------------------------- parity: the three front ends -----
+def test_core_fed_runner_stream_serve_bit_identical_fuzz():
+    """The fuzz parity sweep (ISSUE 8 satellite): the same documents
+    through the direct runner, the streaming engine (prefetch on), and
+    the serve batcher — bit-identical scores on the gather strategy."""
+    langs = list(LANGS)
+    rng = np.random.default_rng(13)
+    train_rows = [
+        {"lang": langs[i % 3], "fulltext": "abc " * (i % 5 + 1) + "zq" * (i % 3)}
+        for i in range(30)
+    ]
+    det = LanguageDetector(langs, [1, 2, 3], 50)
+    model = det.fit(Table.from_rows(train_rows))
+    runner = model._get_runner()
+    assert runner.strategy == "gather"  # geometry-stable reference
+
+    texts = [
+        "".join(
+            chr(int(c)) for c in rng.integers(97, 123, int(rng.integers(1, 300)))
+        )
+        for _ in range(40)
+    ] + ["", "ab" * 600]
+    from spark_languagedetector_tpu.ops.encoding import texts_to_bytes
+
+    docs = texts_to_bytes(texts)
+    direct = runner.score(docs)
+
+    # Stream path: transform through the same model, prefetch pipeline on.
+    sunk: list = []
+    run_stream(
+        model,
+        memory_source([{"fulltext": t} for t in texts], 7),
+        sunk.append,
+        prefetch=2,
+        workers=2,
+    )
+    stream_labels = [
+        lab for t in sunk for lab in t.column(model.get_output_col())
+    ]
+    direct_labels = [langs[i] for i in np.argmax(direct, axis=1)]
+    assert stream_labels == direct_labels
+
+    # Serve path: concurrent submitters, coalesced dispatches.
+    with ContinuousBatcher(runner, max_wait_ms=5, max_rows=64) as b:
+        futs = [b.submit(docs[i::4]) for i in range(4)]
+        for i, fut in enumerate(futs):
+            np.testing.assert_array_equal(
+                fut.result(timeout=30).values, direct[i::4]
+            )
+
+
+def test_chaos_plan_replays_through_shared_wiring_stream_and_serve():
+    """Injected transients at the existing score/dispatch site replay
+    through the core's guarded dispatch identically under both stream
+    and serve — outputs bit-equal to the fault-free oracle."""
+    runner = _runner()
+    rng = np.random.default_rng(23)
+    docs = _docs(rng, 24)
+    oracle = runner.score(docs)
+    plan = FaultPlan.parse("score/dispatch:error@2")
+    with plan_scope(plan):
+        got = runner.score(docs)
+    np.testing.assert_array_equal(got, oracle)
+
+    runner2 = _runner()
+    with plan_scope(FaultPlan.parse("score/dispatch:error@2")):
+        with ContinuousBatcher(runner2, max_wait_ms=2, max_rows=256) as b:
+            np.testing.assert_array_equal(
+                b.submit(docs).result(timeout=30).values, oracle
+            )
+
+
+def test_runner_loads_tuning_profile_at_startup(monkeypatch, tmp_path):
+    prof = TuningProfile(
+        tuned={"length_buckets": [256, 2048], "batch_bytes": 1 << 20}
+    )
+    path = tmp_path / "p.json"
+    prof.save(str(path))
+    monkeypatch.setenv(exec_config.PROFILE_ENV, str(path))
+    exec_config.reload_profile()
+    tuned_runner = _runner()
+    assert tuned_runner.length_buckets == (256, 2048)
+    assert tuned_runner.batch_bytes == 1 << 20
+    # Explicit ctor values still win over the profile.
+    pinned = _runner(length_buckets=(64, 512), batch_bytes=2 << 20)
+    assert pinned.length_buckets == (64, 512)
+    assert pinned.batch_bytes == 2 << 20
+    # Parity across lattices: gather scores are geometry-stable.
+    rng = np.random.default_rng(5)
+    docs = _docs(rng, 30, max_len=3000)
+    monkeypatch.delenv(exec_config.PROFILE_ENV)
+    exec_config.reload_profile()
+    np.testing.assert_array_equal(
+        _runner().score(docs), tuned_runner.score(docs)
+    )
+
+
+def test_serve_batcher_resolves_knobs_from_profile(monkeypatch, tmp_path):
+    prof = TuningProfile(
+        tuned={
+            "serve_max_rows": 32, "serve_max_wait_ms": 3.0,
+            "serve_queue_rows": 64,
+        }
+    )
+    path = tmp_path / "p.json"
+    prof.save(str(path))
+    monkeypatch.setenv(exec_config.PROFILE_ENV, str(path))
+    exec_config.reload_profile()
+    with ContinuousBatcher(_runner()) as b:
+        assert b.max_rows == 32
+        assert b.max_wait_s == pytest.approx(0.003)
+        assert b.max_queue_rows == 64
+    monkeypatch.setenv("LANGDETECT_SERVE_MAX_ROWS", "16")
+    with ContinuousBatcher(_runner()) as b:
+        assert b.max_rows == 16  # env beats profile
+    with ContinuousBatcher(_runner(), max_rows=8) as b:
+        assert b.max_rows == 8  # explicit beats both
+
+
+# ------------------------------------------------- compare: fill contract ---
+def _capture_events(fill_mean, waste_mean, coalesced, capacity):
+    return [
+        {"event": "telemetry.span", "ts": 1.0, "path": "serve/dispatch",
+         "wall_s": 0.01},
+        {
+            "event": "telemetry.snapshot", "ts": 2.0,
+            "counters": {
+                "serve/coalesced_rows": coalesced,
+                "serve/dispatch_capacity_rows": capacity,
+            },
+            "gauges": {},
+            "histograms": {
+                "serve/fill_ratio": {
+                    "count": 10, "mean": fill_mean, "p99": fill_mean,
+                },
+                "serve/padding_waste": {
+                    "count": 10, "mean": waste_mean, "p99": waste_mean,
+                },
+            },
+        },
+    ]
+
+
+def test_compare_regresses_serve_fill_down_and_waste_up():
+    base = capture_stats(_capture_events(0.9, 0.1, 900, 1000))
+    worse = capture_stats(_capture_events(0.4, 0.6, 400, 1000))
+    assert base["tracked"]["fill_ratio[serve/coalesce]"] == pytest.approx(0.9)
+    lines, regressions = compare_captures(base, worse, threshold=0.25)
+    text = "\n".join(regressions)
+    assert "serve/fill_ratio" in text  # fill dropping IS the regression
+    assert "serve/padding_waste" in text
+    assert "fill_ratio[serve/coalesce]" in text
+    # The good direction never regresses: tuned run vs untuned baseline.
+    lines, regressions = compare_captures(worse, base, threshold=0.25)
+    assert not regressions
+
+
+def test_compare_tracks_score_wire_fill_from_counters():
+    def ev(real, cap):
+        return [
+            {"event": "telemetry.span", "ts": 1.0, "path": "score",
+             "wall_s": 0.01},
+            {"event": "telemetry.snapshot", "ts": 2.0,
+             "counters": {"score/real_bytes": real,
+                          "score/capacity_bytes": cap},
+             "gauges": {}, "histograms": {}},
+        ]
+
+    base = capture_stats(ev(800, 1000))
+    worse = capture_stats(ev(400, 1000))
+    assert base["tracked"]["fill_ratio[score/wire]"] == pytest.approx(0.8)
+    _, regressions = compare_captures(base, worse, threshold=0.25)
+    assert any("fill_ratio[score/wire]" in r for r in regressions)
+    _, regressions = compare_captures(worse, base, threshold=0.25)
+    assert not regressions
+
+
+# ------------------------------------------------------- bench smoke gate ---
+@pytest.mark.slow
+def test_bench_smoke_tune_gates(tmp_path):
+    import bench
+
+    result = bench.smoke_tune(str(tmp_path / "tune.jsonl"))
+    assert result["ok"], result["errors"]
+    assert result["argmax_parity"] == 1.0
+    assert (
+        result["padding_waste"]["tuned"] < result["padding_waste"]["untuned"]
+    )

@@ -1459,6 +1459,250 @@ def smoke_serve(jsonl_path: str | None = None) -> dict:
     return result
 
 
+def smoke_fleet(jsonl_path: str | None = None, *, trimmed: bool = False) -> dict:
+    """CPU-safe fleet smoke: replicated serving under chaos.
+
+    Spins up 3 serve replicas (each its own registry + batcher + HTTP
+    server) behind the health-checked router and its HTTP front tier,
+    then drives the fleet with concurrent socket clients while the
+    script: (1) kills a replica mid-traffic and hammers until the router
+    demonstrably fails requests over to the survivors, (2) waits for the
+    ejection (breaker open) and, after reviving the replica, the
+    half-open re-admission, and (3) performs a fleet-wide two-phase
+    hot-swap mid-traffic. Clients honor ``Retry-After`` with the seeded
+    backoff, so transient fleet-wide sheds are absorbed, not dropped.
+
+    Hard gates (``main()`` exits nonzero): zero dropped responses (every
+    request answered despite the kill and the swap), argmax parity
+    exactly 1.0 against the direct runner of whichever version served
+    each response, at least one observed failover AND ejection AND
+    re-admission, and swap atomicity — both versions served, and no
+    client stream ever sees the old version again after its first
+    new-version response. ``trimmed=True`` is the tier-1-sized variant
+    (fewer clients/rounds, same gates).
+    """
+    import tempfile
+    import threading
+
+    from spark_languagedetector_tpu import LanguageDetector, Table
+    from spark_languagedetector_tpu.ops.encoding import texts_to_bytes
+    from spark_languagedetector_tpu.resilience.policy import RetryPolicy
+    from spark_languagedetector_tpu.serve.client import ServeClient, ServeHTTPError
+    from spark_languagedetector_tpu.serve.fleet import ServeFleet
+    from spark_languagedetector_tpu.serve.router import RouterServer
+    from spark_languagedetector_tpu.telemetry import REGISTRY
+    from spark_languagedetector_tpu.telemetry.export import JsonlSink
+
+    REGISTRY.reset()
+    path = jsonl_path or os.path.join(
+        tempfile.gettempdir(), f"fleet_smoke_{os.getpid()}.jsonl"
+    )
+    sink = JsonlSink(path)
+    REGISTRY.add_sink(sink)
+
+    # gram_lengths [1,2,3] keep every replica runner on the gather
+    # strategy (geometry-stable), so label parity vs the direct runner is
+    # strategy-sound across coalesce geometries (docs/SERVING.md §1).
+    langs = language_names(3)
+    docs, labels = make_corpus(langs, 60, mean_len=200, seed=3)
+    model_a = LanguageDetector(langs, [1, 2, 3], 200).fit(
+        Table({"lang": labels, "fulltext": docs})
+    )
+    docs_b, labels_b = make_corpus(langs, 60, mean_len=200, seed=9)
+    model_b = LanguageDetector(langs, [1, 2, 3], 150).fit(
+        Table({"lang": labels_b, "fulltext": docs_b})
+    )
+    runner_a, runner_b = model_a._get_runner(), model_b._get_runner()
+    tmpdir = tempfile.mkdtemp(prefix="fleet_smoke_model_")
+    dir_a, dir_b = tmpdir + "/a", tmpdir + "/b"
+    model_a.save(dir_a)
+    model_b.save(dir_b)
+
+    n_clients = 4 if trimmed else 6
+    rounds = 9 if trimmed else 14
+    docs_per_req = 4
+    kill_round = 2
+    revive_round = rounds // 2
+    swap_round = rounds - 3
+    victim = "r0"  # lowest index: the deterministic tie-break routes the
+    # first idle-fleet request here, so post-kill traffic MUST fail over.
+
+    fleet = ServeFleet.from_path(
+        dir_a, replicas=3,
+        router_kw=dict(
+            probe_interval_ms=40.0, breaker_threshold=2,
+            breaker_cooldown_s=0.3, probe_timeout_s=2.0,
+            drain_timeout_s=5.0,
+        ),
+        max_wait_ms=4, max_rows=64, max_queue_rows=512,
+    ).start()
+    front = RouterServer(fleet.router, fleet=fleet, port=0).start()
+    host, port = front.address
+    v_old = "v1"
+    v_new: list[str | None] = [None]
+    swap_ms = [0.0]
+
+    barrier = threading.Barrier(n_clients)
+    lock = threading.Lock()
+    # per-client ordered (texts, labels, version, replica) sequences — the
+    # per-stream swap-atomicity gate needs request ORDER per client.
+    streams: list[list[tuple[list, list, str, str]]] = [
+        [] for _ in range(n_clients)
+    ]
+    errors: list[str] = []
+
+    def counter(name: str) -> int:
+        return int(REGISTRY.snapshot()["counters"].get(name, 0))
+
+    def wait_for(pred, timeout_s: float) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(0.02)
+        return pred()
+
+    def drive(ci: int) -> None:
+        rng = np.random.default_rng(300 + ci)
+        client = ServeClient(
+            host, port, retry_policy=RetryPolicy(
+                max_attempts=8, base_delay_s=0.05, max_delay_s=0.5,
+                seed=300 + ci,
+            ),
+        )
+
+        def one_request(tag: str) -> None:
+            lo = int(rng.integers(0, len(docs) - docs_per_req))
+            texts = docs[lo:lo + docs_per_req]
+            try:
+                got, meta = client.detect(texts)
+            except (ServeHTTPError, OSError) as e:
+                with lock:
+                    errors.append(f"client {ci} {tag}: {e}")
+                return
+            with lock:
+                streams[ci].append(
+                    (texts, got, meta["version"], meta["replica"])
+                )
+
+        for r in range(rounds):
+            try:
+                barrier.wait(timeout=60)
+            except threading.BrokenBarrierError:
+                pass
+            if ci == 0 and r == kill_round:
+                # Replica kill mid-traffic: hammer until the router has
+                # observably failed at least one request over (the other
+                # clients are mid-round too, so mid-flight failures are
+                # also in play).
+                fleet.replica(victim).kill()
+                for _ in range(30):
+                    one_request(f"round {r} (post-kill)")
+                    if counter("fleet/failovers") >= 1:
+                        break
+                continue
+            if ci == 0 and r == revive_round:
+                # The prober must have ejected the dead replica by now;
+                # revive it and wait for the half-open re-admission.
+                wait_for(lambda: counter("fleet/ejections") >= 1, 5.0)
+                fleet.replica(victim).revive()
+                wait_for(
+                    lambda: len(fleet.router.eligible()) == 3, 10.0
+                )
+                continue
+            if ci == 0 and r == swap_round:
+                client_plain = ServeClient(host, port)
+                t0 = time.perf_counter()
+                v_new[0] = client_plain.swap(dir_b)
+                swap_ms[0] = (time.perf_counter() - t0) * 1e3
+                continue
+            one_request(f"round {r}")
+
+    threads = [
+        threading.Thread(target=drive, args=(ci,)) for ci in range(n_clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    final_health = fleet.router.healthz()
+    front.stop()
+    fleet.close()
+
+    # Parity: every response must match the direct runner of the version
+    # that served it — label-exact (argmax), across failovers and the swap.
+    checked = mismatches = 0
+    versions_served: set[str] = set()
+    interleaved_streams = 0
+    for ci, stream in enumerate(streams):
+        seen_new = False
+        for texts, got, version, replica in stream:
+            versions_served.add(version)
+            runner = runner_a if version == v_old else runner_b
+            ids = runner.predict_ids(texts_to_bytes(texts))
+            want = [langs[int(i)] for i in ids]
+            checked += 1
+            if got != want:
+                mismatches += 1
+            if version == v_new[0]:
+                seen_new = True
+            elif seen_new:  # old version AFTER the new one: interleaved
+                interleaved_streams += 1
+                break
+    parity = 1.0 if checked and mismatches == 0 else (
+        round(1.0 - mismatches / checked, 6) if checked else 0.0
+    )
+
+    snap = REGISTRY.snapshot()
+    counters = snap["counters"]
+    req_h = snap["histograms"].get("fleet/request_s", {})
+    answered = sum(len(s) for s in streams)
+    result = {
+        "smoke_fleet": True,
+        "trimmed": trimmed,
+        "replicas": 3,
+        "clients": n_clients,
+        "answered": answered,
+        "dropped_responses": len(errors),
+        "errors": errors[:5],
+        "argmax_parity": parity,
+        "failovers": int(counters.get("fleet/failovers", 0)),
+        "ejections": int(counters.get("fleet/ejections", 0)),
+        "readmissions": int(counters.get("fleet/readmissions", 0)),
+        "fleet_sheds": int(counters.get("fleet/shed_requests", 0)),
+        "client_retries": int(counters.get("serve/client_retries", 0)),
+        "latency_ms": {
+            "p50": round(req_h.get("p50", 0.0) * 1e3, 3),
+            "p99": round(req_h.get("p99", 0.0) * 1e3, 3),
+        },
+        "swap": {
+            "from": v_old,
+            "to": v_new[0],
+            "wall_ms": round(swap_ms[0], 3),
+            "versions_served": sorted(versions_served),
+            "interleaved_streams": interleaved_streams,
+        },
+        "health": {
+            "ready_replicas": final_health["ready_replicas"],
+            "pinned_version": final_health["pinned_version"],
+        },
+        "telemetry": telemetry_block(path),
+    }
+    result["ok"] = bool(
+        not errors
+        and parity == 1.0
+        and result["failovers"] >= 1
+        and result["ejections"] >= 1
+        and result["readmissions"] >= 1
+        and v_new[0] is not None
+        and versions_served == {v_old, v_new[0]}
+        and interleaved_streams == 0
+        and len(final_health["ready_replicas"]) == 3
+    )
+    REGISTRY.remove_sink(sink)
+    return result
+
+
 def smoke_refit(jsonl_path: str | None = None) -> dict:
     """CPU-safe continuous-learning smoke: the full data-in → model-out →
     serving loop under one gate (ROADMAP item 2).
@@ -2531,6 +2775,35 @@ def main():
                 + (
                     "; ".join(result["errors"])
                     or "gate (parity/dropped/coalescing/shed) not met"
+                ),
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        return
+    if "--smoke-fleet" in sys.argv[1:]:
+        # Fleet smoke path: 3 replicas behind the health-checked router,
+        # concurrent socket clients, a scripted mid-run replica kill +
+        # half-open re-admission, and a fleet-wide two-phase hot-swap.
+        # Gates: zero dropped responses, argmax parity 1.0 per served
+        # version, >=1 failover/ejection/re-admission, swap atomicity.
+        args = [a for a in sys.argv[1:] if a != "--smoke-fleet"]
+        flags = [a for a in args if a.startswith("-")]
+        if flags or len(args) > 1:
+            print(
+                f"usage: python bench.py --smoke-fleet [out.jsonl] "
+                f"(got {args})",
+                file=sys.stderr,
+            )
+            sys.exit(2)
+        result = smoke_fleet(args[0] if args else None)
+        print(json.dumps(result), flush=True)
+        if not result["ok"]:
+            print(
+                "fleet smoke FAILED: "
+                + (
+                    "; ".join(result["errors"])
+                    or "gate (drop/parity/failover/ejection/readmission/"
+                    "swap-atomicity) not met"
                 ),
                 file=sys.stderr,
             )

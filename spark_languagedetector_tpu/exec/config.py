@@ -102,6 +102,27 @@ KNOBS: dict[str, Knob] = _knobs(
          tunable=True, positive=True),
     Knob("serve_slo_ms", "LANGDETECT_SERVE_SLO_MS", "float", 0.0,
          "estimated-wait shed threshold (0: off)"),
+    # --- fleet (replicated serving: router + replicas) --------------------
+    Knob("fleet_replicas", "LANGDETECT_FLEET_REPLICAS", "int", 3,
+         "serve replicas behind the fleet router", positive=True),
+    Knob("fleet_probe_interval_ms", "LANGDETECT_FLEET_PROBE_INTERVAL_MS",
+         "float", 100.0, "router health-probe period per round",
+         positive=True),
+    Knob("fleet_probe_timeout_s", "LANGDETECT_FLEET_PROBE_TIMEOUT_S",
+         "float", 2.0, "liveness/readiness probe HTTP timeout",
+         positive=True),
+    Knob("fleet_dispatch_attempts", "LANGDETECT_FLEET_DISPATCH_ATTEMPTS",
+         "int", 3, "distinct replicas tried per request before the fleet "
+         "sheds", positive=True),
+    Knob("fleet_breaker_threshold", "LANGDETECT_FLEET_BREAKER_THRESHOLD",
+         "int", 3, "consecutive probe/dispatch failures that eject a "
+         "replica", positive=True),
+    Knob("fleet_breaker_cooldown_s", "LANGDETECT_FLEET_BREAKER_COOLDOWN_S",
+         "float", 1.0, "ejection -> half-open re-probe cooldown",
+         positive=True),
+    Knob("fleet_drain_timeout_s", "LANGDETECT_FLEET_DRAIN_TIMEOUT_S",
+         "float", 10.0, "per-replica drain bound during the two-phase "
+         "fleet swap", positive=True),
     # --- resilience -------------------------------------------------------
     Knob("retry_max_attempts", "LANGDETECT_RETRY_MAX_ATTEMPTS", "int", 2,
          "retry attempts incl. the first try"),

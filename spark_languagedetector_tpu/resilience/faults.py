@@ -47,6 +47,17 @@ Sites (one hook per serving layer; docs/RESILIENCE.md §4):
     :class:`~..serve.batcher.ServeOverloaded`, exactly like a full
     queue), so chaos plans drive the load-shedding and hot-swap paths
     deterministically on CPU.
+  * ``fleet/probe``    — each router health-probe attempt against one
+    replica (:meth:`serve.router.FleetRouter.probe_once`): a firing
+    ``error`` reads as "replica unreachable", so probe-flap plans drive
+    ejection and half-open re-admission deterministically.
+  * ``fleet/dispatch`` — each routed dispatch attempt to one replica:
+    a firing ``error`` is a replica dying mid-flight, exercising the
+    failover/retry-on-another-replica path.
+  * ``fleet/swap``     — each per-replica step of the fleet-wide
+    two-phase hot-swap (every phase-1 prepare, every phase-2 commit):
+    plans abort phase 1 everywhere or crash mid-phase-2 and replay the
+    rollback deterministically (docs/SERVING.md §9).
 """
 
 from __future__ import annotations
@@ -72,6 +83,9 @@ SITES = (
     "fit/count",
     "shard_step",
     "serve/admit",
+    "fleet/probe",
+    "fleet/dispatch",
+    "fleet/swap",
 )
 
 KINDS = ("error", "delay", "poison")

@@ -10,7 +10,13 @@ concurrent small requests through the same compiled dispatch
   * :class:`~.registry.ModelRegistry` — versioned models with pre-warmed
     zero-downtime hot-swap and rollback;
   * :class:`~.server.ServingServer` / :class:`~.client.ServeClient` —
-    stdlib-only JSON-over-HTTP front end and client.
+    stdlib-only JSON-over-HTTP front end and client, with split
+    liveness/readiness probes and ``Retry-After``-honoring client
+    retries;
+  * :class:`~.fleet.ServeFleet` / :class:`~.router.FleetRouter` /
+    :class:`~.router.RouterServer` — N replicas behind a health-checked
+    router with replica failover and the coordinated two-phase
+    fleet-wide hot-swap (docs/SERVING.md §9).
 
 Importing this package never initializes jax — runners are built by the
 models the registry loads.
@@ -56,4 +62,15 @@ def __getattr__(name):
         from . import client
 
         return getattr(client, name)
+    if name in (
+        "FleetRouter", "RouterServer", "FleetSaturated", "NoReadyReplica",
+        "FleetSwapError",
+    ):
+        from . import router
+
+        return getattr(router, name)
+    if name in ("ServeFleet", "ServeReplica"):
+        from . import fleet
+
+        return getattr(fleet, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

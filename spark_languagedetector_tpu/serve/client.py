@@ -1,25 +1,42 @@
 """Stdlib HTTP client for the serving front end.
 
-Thin, dependency-free wrapper over :mod:`http.client` mirroring the
-server's endpoints — the piece that makes the smoke bench and the tests
-drive the whole stack over a real socket. One connection per call keeps
-the client trivially thread-safe (concurrent smoke clients share one
-``ServeClient``); the server is HTTP/1.1 keep-alive, so per-call
-connections cost one local TCP handshake, which is noise next to a
-scoring dispatch.
+Thin wrapper over :mod:`http.client` mirroring the server's endpoints —
+the piece that makes the smoke bench and the tests drive the whole stack
+over a real socket. One connection per call keeps the client trivially
+thread-safe (concurrent smoke clients share one ``ServeClient``); the
+server is HTTP/1.1 keep-alive, so per-call connections cost one local
+TCP handshake, which is noise next to a scoring dispatch.
 
 Non-2xx responses raise :class:`ServeHTTPError` carrying the status and
 decoded body — a shed (503) or blown deadline (504) is an exception with
 context, never a silent empty result.
+
+Pass a :class:`~..resilience.policy.RetryPolicy` as ``retry_policy`` and
+the *idempotent* calls (``score``/``detect`` and every GET) ride it: a
+503 shed sleeps ``max(Retry-After, seeded-jitter backoff)`` and retries,
+bounded by ``max_attempts`` — the client-side half of load shedding
+(the server asks for a later retry; the client grants it). 400 (caller
+bug) and 504 (blown deadline) are never retried; connection-level
+failures ride the same :func:`~..resilience.policy.is_retryable`
+taxonomy the serving layers use. Admin calls (``swap``/``rollback``)
+never retry — replaying a non-idempotent mutation is the caller's
+decision, not the transport's.
 """
 
 from __future__ import annotations
 
 import json
-from http.client import HTTPConnection
+import time
+from http.client import HTTPConnection, HTTPException
 from typing import Sequence
 
 import numpy as np
+
+from ..resilience.policy import RetryPolicy, is_retryable
+from ..telemetry import REGISTRY
+from ..utils.logging import get_logger, log_event
+
+_log = get_logger("serve.client")
 
 
 class ServeHTTPError(RuntimeError):
@@ -48,13 +65,23 @@ class ServeHTTPError(RuntimeError):
 class ServeClient:
     """JSON client for one serving endpoint (host, port)."""
 
-    def __init__(self, host: str, port: int, *, timeout_s: float = 60.0):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        timeout_s: float = 60.0,
+        retry_policy: RetryPolicy | None = None,
+    ):
         self.host = host
         self.port = port
         self.timeout_s = timeout_s
+        self.retry_policy = retry_policy
 
     # ------------------------------------------------------------- wire -----
-    def _request(self, method: str, path: str, payload: dict | None = None):
+    def _request_once(
+        self, method: str, path: str, payload: dict | None = None
+    ):
         conn = HTTPConnection(self.host, self.port, timeout=self.timeout_s)
         try:
             body = None if payload is None else json.dumps(payload)
@@ -68,6 +95,55 @@ class ServeClient:
             return data
         finally:
             conn.close()
+
+    @staticmethod
+    def _retryable(exc: Exception) -> bool:
+        """503 (shed/closed: the server asked for a later retry) and
+        transport failures retry; 400 and 504 never do — a bad request
+        stays bad and a blown deadline's answer is already worthless."""
+        if isinstance(exc, ServeHTTPError):
+            return exc.status == 503
+        return isinstance(exc, HTTPException) or is_retryable(exc)
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        payload: dict | None = None,
+        *,
+        idempotent: bool | None = None,
+    ):
+        if idempotent is None:
+            idempotent = method == "GET"
+        policy = self.retry_policy
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                return self._request_once(method, path, payload)
+            except Exception as e:
+                if (
+                    policy is None
+                    or not idempotent
+                    or not self._retryable(e)
+                    or attempt >= policy.max_attempts
+                ):
+                    raise
+                # The server's own estimate wins when it is longer than
+                # the schedule: Retry-After says when capacity frees, the
+                # seeded-jitter backoff (deterministic per policy seed +
+                # attempt — resilience/policy) de-synchronizes the herd.
+                delay = policy.backoff_s(attempt)
+                if isinstance(e, ServeHTTPError):
+                    delay = max(delay, e.retry_after_s)
+                REGISTRY.incr("serve/client_retries")
+                log_event(
+                    _log, "serve.client.retry", path=path, attempt=attempt,
+                    max_attempts=policy.max_attempts,
+                    backoff_s=round(delay, 6), error=repr(e),
+                )
+                if delay > 0:
+                    time.sleep(delay)
 
     # -------------------------------------------------------------- api -----
     def score(
@@ -86,7 +162,7 @@ class ServeClient:
             payload["deadline_ms"] = deadline_ms
         if trace_id is not None:
             payload["trace_id"] = trace_id
-        data = self._request("POST", "/score", payload)
+        data = self._request("POST", "/score", payload, idempotent=True)
         scores = np.asarray(data.pop("scores"), dtype=np.float32)
         if scores.size == 0:
             scores = scores.reshape(0, 0)
@@ -103,11 +179,30 @@ class ServeClient:
         payload: dict = {"texts": list(texts), "priority": priority}
         if deadline_ms is not None:
             payload["deadline_ms"] = deadline_ms
-        data = self._request("POST", "/detect", payload)
+        data = self._request("POST", "/detect", payload, idempotent=True)
         return data.pop("labels"), data
 
     def healthz(self) -> dict:
         return self._request("GET", "/healthz")
+
+    def livez(self) -> dict:
+        return self._request("GET", "/healthz/live")
+
+    def readyz(self) -> dict:
+        """The readiness payload, whether ready (200) or not (503) — a
+        not-ready replica answering its probe is information, not an
+        error (the router keys routing off ``payload["ready"]``). Never
+        retried, even with a retry policy: a probe wants the state *now*,
+        and retrying a 503 until ready would just re-implement the
+        router's re-admission loop badly."""
+        try:
+            return self._request(
+                "GET", "/healthz/ready", idempotent=False
+            )
+        except ServeHTTPError as e:
+            if e.status == 503 and isinstance(e.payload, dict):
+                return e.payload
+            raise
 
     def varz(self) -> dict:
         return self._request("GET", "/varz")
@@ -116,7 +211,11 @@ class ServeClient:
         payload: dict = {"path": path}
         if version is not None:
             payload["version"] = version
-        return self._request("POST", "/admin/swap", payload)["version"]
+        return self._request(
+            "POST", "/admin/swap", payload, idempotent=False
+        )["version"]
 
     def rollback(self) -> str:
-        return self._request("POST", "/admin/rollback")["version"]
+        return self._request(
+            "POST", "/admin/rollback", idempotent=False
+        )["version"]

@@ -1,0 +1,436 @@
+"""A replicated serving fleet: N replicas + router + coordinated swaps.
+
+The pjit/TPUv4 serving lesson (PAPERS.md: arXiv:2204.06514) and GSPMD
+portability make replication the cheap axis of scale: every replica runs
+the same compiled program at the same speed. This module owns the part
+that does NOT replicate for free — construction, failure drills, and the
+**two-phase fleet-wide hot-swap** that keeps N independently-swapping
+registries from ever serving two model versions to one client stream:
+
+Phase 1 — *prepare everywhere*: each replica's registry builds and
+pre-warms the standby runner off its serving path
+(:meth:`~.registry.ModelRegistry.prepare`). Any failure — a bad model
+directory, an OOM, an injected ``fleet/swap`` fault — aborts the swap on
+every replica; nothing was serving-visible, the current version keeps
+serving.
+
+Phase 2 — *drain + flip, one replica at a time*: the router marks the
+replica draining (readiness false — no new traffic), waits for its
+outstanding requests, commits the flip, and moves on. The router's
+version pin makes the fleet-level cutover a single monotonic step: it
+pins the OLD version before the first flip and moves to the NEW version
+immediately after it, so an individual client stream sees
+``old … old | new … new`` — never an interleave — while every individual
+response is answered by exactly one version (the per-registry lease
+contract). A crash mid-phase-2 (injected or real) rolls every
+already-flipped replica back, re-pins the old version, and raises: the
+fleet converges to one consistent version on either side of the failure,
+never a mix.
+
+``bench.py --smoke-fleet`` chaos-tests the whole story on the CPU
+substrate: concurrent socket clients, a mid-run replica kill + half-open
+re-admission, and a mid-traffic fleet swap, hard-gated on zero dropped
+responses and swap atomicity (docs/SERVING.md §9).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+
+from ..exec import config as exec_config
+from ..resilience import faults
+from ..telemetry import REGISTRY, span
+from ..utils.logging import get_logger, log_event
+from .registry import ModelRegistry
+from .router import FleetRouter, FleetSwapError
+from .server import ServingServer
+
+_log = get_logger("serve.fleet")
+
+
+class ServeReplica:
+    """One fleet member: its own registry, batcher, and HTTP server.
+
+    The port is pinned on first bind (``port=0`` resolves an ephemeral
+    one), so :meth:`kill` / :meth:`revive` cycles — the chaos drill — put
+    the replica back at the same address the router knows.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        model,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        version: str = "v1",
+        prewarm: bool = True,
+        **batcher_kw,
+    ):
+        self.name = name
+        self.registry = ModelRegistry()
+        self.registry.install(model, version=version, prewarm=prewarm)
+        self._host = host
+        self._port = port
+        self._batcher_kw = dict(batcher_kw)
+        self.server: ServingServer | None = None
+        self.start()
+
+    # ---------------------------------------------------------- lifecycle ---
+    @property
+    def address(self) -> tuple[str, int]:
+        return (self._host, self._port)
+
+    @property
+    def alive(self) -> bool:
+        return self.server is not None
+
+    def start(self) -> "ServeReplica":
+        """(Re)start the HTTP server + a fresh batcher on the pinned
+        address. The registry — versions, history, leases — survives the
+        restart: a revived replica serves whatever it served before, and
+        the router's version pin keeps it out of rotation if the fleet
+        moved on while it was down."""
+        if self.server is not None:
+            return self
+        self.server = ServingServer(
+            self.registry, host=self._host, port=self._port,
+            **self._batcher_kw,
+        ).start()
+        self._port = self.server.address[1]
+        log_event(_log, "fleet.replica.start", replica=self.name,
+                  port=self._port)
+        return self
+
+    revive = start
+
+    def kill(self) -> None:
+        """Abrupt death (the chaos drill): new connections refuse, queued
+        requests fail explicitly with 503 — mid-flight routed requests
+        surface as retryable failures the router fails over."""
+        if self.server is None:
+            return
+        self.server.stop(drain=False)
+        self.server = None
+        log_event(_log, "fleet.replica.killed", replica=self.name)
+
+    def stop(self) -> None:
+        """Graceful stop: drain accepted work, then tear down."""
+        if self.server is None:
+            return
+        self.server.stop(drain=True)
+        self.server = None
+        log_event(_log, "fleet.replica.stop", replica=self.name)
+
+    def batcher_idle(self) -> bool:
+        if self.server is None:
+            return True
+        stats = self.server.batcher.stats()
+        return stats["queued_rows"] == 0 and stats["inflight_rows"] == 0
+
+
+class ServeFleet:
+    """N serve replicas behind one :class:`~.router.FleetRouter`.
+
+    ``models`` is one fitted model per replica — distinct instances or
+    the same shared object (what :meth:`from_path` does: one copy of the
+    weights per process; replicas isolate serving state, not tables).
+    """
+
+    def __init__(
+        self,
+        models,
+        *,
+        host: str = "127.0.0.1",
+        version: str = "v1",
+        router_kw: dict | None = None,
+        **batcher_kw,
+    ):
+        models = list(models)
+        if not models:
+            raise ValueError("a fleet needs at least one replica model")
+        # Pre-warm once per DISTINCT model object: with the shared-model
+        # form every replica holds the same cached runner, and N-1 of
+        # the prewarm scores would be pure repeats.
+        seen: set[int] = set()
+        self.replicas = []
+        for i, model in enumerate(models):
+            first = id(model) not in seen
+            seen.add(id(model))
+            self.replicas.append(ServeReplica(
+                f"r{i}", model, host=host, version=version,
+                prewarm=first, **batcher_kw,
+            ))
+        self.router = FleetRouter(self.replicas, **(router_kw or {}))
+        self.router.pin_version(version)
+        # Serializes swap/rollback: the two-phase protocol assumes one
+        # coordinator — two interleaved swaps could wedge the fleet with
+        # the pin naming a version no replica serves.
+        self._swap_lock = threading.Lock()
+
+    @classmethod
+    def from_path(
+        cls,
+        path: str,
+        *,
+        replicas: int | None = None,
+        **kw,
+    ) -> "ServeFleet":
+        """Build ``replicas`` replicas (default: the ``fleet_replicas``
+        knob) from one persisted model directory. The model is loaded
+        ONCE and shared — in one process there is no reason to hold N
+        copies of the same weights or compile N identical programs
+        (runners are concurrent-caller-safe, the documented PR-5
+        contract); a replica's failure domain is its serving state —
+        registry, batcher, HTTP server — not the weights. Replicas in
+        separate processes/hosts each load their own copy by
+        construction."""
+        from ..models.estimator import LanguageDetectorModel
+
+        n = int(exec_config.resolve("fleet_replicas", replicas))
+        model = LanguageDetectorModel.load(path)
+        return cls([model] * n, **kw)
+
+    # ---------------------------------------------------------- lifecycle ---
+    def start(self, *, probe: bool = True) -> "ServeFleet":
+        self.router.start(probe=probe)
+        return self
+
+    def close(self) -> None:
+        self.router.close()
+        for rep in self.replicas:
+            rep.stop()
+
+    def __enter__(self) -> "ServeFleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def replica(self, name: str) -> ServeReplica:
+        for rep in self.replicas:
+            if rep.name == name:
+                return rep
+        raise ValueError(f"unknown replica {name!r}")
+
+    # ------------------------------------------------------------- swaps ----
+    def _next_version(self) -> str:
+        n = 0
+        for rep in self.replicas:
+            for v in rep.registry.versions():
+                m = re.fullmatch(r"v(\d+)", v["version"])
+                if m:
+                    n = max(n, int(m.group(1)))
+        return f"v{n + 1}"
+
+    def _load_models(self, path: str) -> list:
+        # One load, shared across the in-process replicas — the same
+        # one-copy-per-process rule as from_path().
+        from ..models.estimator import LanguageDetectorModel
+
+        return [LanguageDetectorModel.load(path)] * len(self.replicas)
+
+    def swap(
+        self,
+        path: str | None = None,
+        *,
+        models=None,
+        version: str | None = None,
+        prewarm: bool = True,
+    ) -> str:
+        """Fleet-wide two-phase hot-swap; returns the new version name.
+
+        Pass a persisted model directory (loaded once, shared) or
+        ``models`` (one per replica). Raises
+        :class:`~.router.FleetSwapError` on abort/rollback — the fleet is
+        on exactly one version afterwards either way. One swap/rollback
+        at a time: a concurrent call fails fast instead of interleaving
+        two flips (a double-submitted ``/admin/swap`` must not wedge the
+        pin on a version no replica serves).
+        """
+        if not self._swap_lock.acquire(blocking=False):
+            raise FleetSwapError(
+                "a fleet swap/rollback is already in progress"
+            )
+        try:
+            return self._swap_locked(
+                path, models=models, version=version, prewarm=prewarm
+            )
+        finally:
+            self._swap_lock.release()
+
+    def _swap_locked(
+        self,
+        path: str | None,
+        *,
+        models,
+        version: str | None,
+        prewarm: bool,
+    ) -> str:
+        if (path is None) == (models is None):
+            raise ValueError("pass exactly one of path= or models=")
+        if models is not None:
+            models = list(models)
+            if len(models) != len(self.replicas):
+                raise ValueError(
+                    f"need one model per replica ({len(self.replicas)}), "
+                    f"got {len(models)}"
+                )
+        version = version or self._next_version()
+        old = self.router.pinned_version or (
+            self.replicas[0].registry.current_version()
+        )
+        t0 = time.perf_counter()
+        with span(
+            "fleet/swap", replicas=len(self.replicas), version=version
+        ):
+            if models is None:
+                models = self._load_models(path)
+            # ---- phase 1: prepare on EVERY replica, off the serving
+            # path. Any failure aborts the swap everywhere — nothing was
+            # serving-visible yet, the current version keeps serving.
+            # (Pre-warm once per distinct model object: shared models
+            # share one cached runner.)
+            prepared = []
+            warmed: set[int] = set()
+            for rep, model in zip(self.replicas, models):
+                try:
+                    faults.inject("fleet/swap")
+                    prepared.append(rep.registry.prepare(
+                        model, version=version,
+                        prewarm=prewarm and id(model) not in warmed,
+                        source=path and str(path),
+                        metadata={"fleet_swap": version},
+                    ))
+                    warmed.add(id(model))
+                except Exception as e:
+                    REGISTRY.incr("fleet/swap_aborts")
+                    log_event(
+                        _log, "fleet.swap_abort", phase=1, replica=rep.name,
+                        version=version, error=repr(e),
+                    )
+                    raise FleetSwapError(
+                        f"phase 1 (prepare) failed on {rep.name}: {e!r}; "
+                        f"swap aborted fleet-wide, {old!r} keeps serving"
+                    ) from e
+            # ---- phase 2: drain + flip one replica at a time. The pin
+            # starts on the old version; it moves to the new version
+            # exactly once, right after the first flip — the cutover that
+            # keeps per-client-stream versions monotonic.
+            self.router.pin_version(old)
+            flipped: list[ServeReplica] = []
+            current: ServeReplica | None = None
+            try:
+                for i, (rep, prep) in enumerate(
+                    zip(self.replicas, prepared)
+                ):
+                    current = rep
+                    self.router.set_draining(rep.name, True)
+                    self._drain(rep)
+                    faults.inject("fleet/swap")
+                    rep.registry.commit(prep)
+                    self.router.note_version(rep.name, version)
+                    self.router.set_draining(rep.name, False)
+                    flipped.append(rep)
+                    if i == 0:
+                        self.router.pin_version(version)
+            except Exception as e:
+                # Mid-phase-2 crash: converge BACK — the fleet must never
+                # stay mixed. Already-flipped replicas revert to the
+                # NAMED old version (activate, not rollback: history may
+                # hold retired standbys of earlier aborted swaps; "one
+                # step back" would land on those). The old runner is
+                # still cached: instant. Then the pin returns and the
+                # error surfaces.
+                if current is not None:
+                    self.router.set_draining(current.name, False)
+                for rep in flipped:
+                    rep.registry.activate(old)
+                    self.router.note_version(rep.name, old)
+                self.router.pin_version(old)
+                REGISTRY.incr("fleet/swap_aborts")
+                log_event(
+                    _log, "fleet.swap_abort", phase=2,
+                    replica=current.name if current else None,
+                    version=version, rolled_back=[r.name for r in flipped],
+                    error=repr(e),
+                )
+                raise FleetSwapError(
+                    f"phase 2 (commit) failed on "
+                    f"{current.name if current else '?'}: {e!r}; "
+                    f"{len(flipped)} flipped replica(s) rolled back to "
+                    f"{old!r}"
+                ) from e
+        REGISTRY.incr("fleet/swaps")
+        log_event(
+            _log, "fleet.swap", version=version, previous=old,
+            replicas=len(self.replicas),
+            wall_ms=round((time.perf_counter() - t0) * 1e3, 3),
+        )
+        return version
+
+    def rollback(self) -> str:
+        """Fleet-wide rollback: the phase-2 protocol (drain + flip one at
+        a time behind the version pin) walked backwards — instant per
+        replica, since the previous runners are still cached. Mutually
+        exclusive with :meth:`swap` (same single-coordinator rule)."""
+        if not self._swap_lock.acquire(blocking=False):
+            raise FleetSwapError(
+                "a fleet swap/rollback is already in progress"
+            )
+        try:
+            return self._rollback_locked()
+        finally:
+            self._swap_lock.release()
+
+    def _rollback_locked(self) -> str:
+        old = self.router.pinned_version or (
+            self.replicas[0].registry.current_version()
+        )
+        with span("fleet/rollback", replicas=len(self.replicas)):
+            self.router.pin_version(old)
+            target: str | None = None
+            for i, rep in enumerate(self.replicas):
+                self.router.set_draining(rep.name, True)
+                try:
+                    self._drain(rep)
+                    version = rep.registry.rollback()
+                finally:
+                    self.router.set_draining(rep.name, False)
+                if target is None:
+                    target = version
+                elif version != target:
+                    raise FleetSwapError(
+                        f"divergent rollback: {rep.name} landed on "
+                        f"{version!r}, expected {target!r}"
+                    )
+                self.router.note_version(rep.name, version)
+                if i == 0:
+                    self.router.pin_version(version)
+        REGISTRY.incr("fleet/rollbacks")
+        log_event(_log, "fleet.rollback", version=target, previous=old)
+        return target
+
+    def _drain(self, rep: ServeReplica) -> None:
+        """Wait until no routed request is outstanding on ``rep`` and its
+        batcher is idle (bounded). A timeout proceeds anyway — the
+        registry's own lease drain still guarantees in-flight dispatches
+        finish on the version they leased."""
+        deadline = time.monotonic() + self.router.drain_timeout_s
+        self.router.wait_drained(
+            rep.name, timeout_s=max(deadline - time.monotonic(), 0.0)
+        )
+        while not rep.batcher_idle():
+            if time.monotonic() >= deadline:
+                log_event(_log, "fleet.drain_timeout", replica=rep.name)
+                break
+            time.sleep(0.002)
+
+    # ------------------------------------------------------------- status ---
+    def versions(self) -> dict:
+        return {
+            rep.name: rep.registry.current_version()
+            for rep in self.replicas
+        }

@@ -16,6 +16,14 @@ Every request is answered by exactly one version: the dispatcher leases
 the active entry per dispatch, the flip happens between leases, and a
 lease pins its entry until released — no request ever observes half a
 swap (pinned by ``tests/test_serve.py``).
+
+The swap decomposes into explicit phases — :meth:`ModelRegistry.prepare`
+(build + pre-warm the standby runner, nothing serving-visible) and
+:meth:`ModelRegistry.commit` (the pointer flip) — so a *fleet* of
+registries can run a coordinated two-phase flip: prepare on every
+replica first, abort everywhere if any prepare fails, and only then
+commit replica by replica (docs/SERVING.md §9). :meth:`install` is the
+single-registry fusion of the two.
 """
 
 from __future__ import annotations
@@ -77,13 +85,29 @@ class ModelVersion:
         return out
 
 
+class PreparedVersion:
+    """Phase-1 artifact of a two-phase swap: a standby runner, built and
+    pre-warmed off the serving path, not yet serving-visible. Hand it to
+    :meth:`ModelRegistry.commit` to flip it in, or drop it to abort —
+    nothing was ever installed."""
+
+    __slots__ = ("model", "runner", "version", "source", "metadata")
+
+    def __init__(self, model, runner, version, source, metadata):
+        self.model = model
+        self.runner = runner
+        self.version = version
+        self.source = source
+        self.metadata = metadata
+
+
 class ModelRegistry:
     """Serving pointer + version history with atomic flips.
 
     ``install`` is the swap primitive (``load`` is install-from-disk):
-    the standby runner is built and pre-warmed *before* the flip, so the
-    pointer move is the only serving-visible step and takes a lock
-    acquisition, not a compile.
+    the standby runner is built and pre-warmed *before* the flip
+    (``prepare``), so the pointer move (``commit``) is the only
+    serving-visible step and takes a lock acquisition, not a compile.
     """
 
     def __init__(
@@ -101,6 +125,26 @@ class ModelRegistry:
         self._drain_timeout_s = drain_timeout_s
 
     # ------------------------------------------------------------ swaps -----
+    def prepare(
+        self,
+        model,
+        *,
+        version: str | None = None,
+        prewarm: bool = True,
+        source: str | None = None,
+        metadata: dict | None = None,
+    ) -> PreparedVersion:
+        """Phase 1 of a swap: build ``model``'s runner and pre-warm its
+        compile cache, entirely off the serving path. Raises on any
+        build/pre-warm failure — nothing serving-visible has happened, so
+        a caller coordinating many registries can abort everywhere. The
+        returned handle is flipped in by :meth:`commit` (version-name
+        conflicts are checked there, at flip time)."""
+        runner = model._get_runner()
+        if prewarm and self._prewarm_docs:
+            runner.score(list(self._prewarm_docs))
+        return PreparedVersion(model, runner, version, source, metadata)
+
     def install(
         self,
         model,
@@ -114,18 +158,27 @@ class ModelRegistry:
 
         Returns the version name (auto ``v1``, ``v2``, … when not given).
         The runner is built and optionally pre-warmed on the standby side
-        first; only then does the serving pointer flip. The previously
-        active version is drained (bounded by ``drain_timeout_s``) and
-        retired — but kept in history for :meth:`rollback`.
+        first (:meth:`prepare`); only then does the serving pointer flip
+        (:meth:`commit`). The previously active version is drained
+        (bounded by ``drain_timeout_s``) and retired — but kept in
+        history for :meth:`rollback`.
 
         ``metadata``: optional provenance dict surfaced by ``describe()``/
         ``versions()`` (and thus ``/varz``) — the auto-refit driver stamps
         its refit token and doc coverage here so an operator can tell WHICH
         accumulated corpus a serving version was finalized from.
         """
-        runner = model._get_runner()
-        if prewarm and self._prewarm_docs:
-            runner.score(list(self._prewarm_docs))
+        return self.commit(self.prepare(
+            model, version=version, prewarm=prewarm, source=source,
+            metadata=metadata,
+        ))
+
+    def commit(self, prepared: PreparedVersion) -> str:
+        """Phase 2 of a swap: atomically flip the serving pointer to a
+        :meth:`prepare`\\ d standby. Returns the version name."""
+        model, runner = prepared.model, prepared.runner
+        version, source = prepared.version, prepared.source
+        metadata = prepared.metadata
         with self._cv:
             if version is None:
                 # Auto names skip anything already registered (an explicit
@@ -189,6 +242,44 @@ class ModelRegistry:
         )
         self._retire(old)
         return entry.version
+
+    def activate(self, version: str) -> str:
+        """Flip the serving pointer to a *named* version already in
+        history. This is the fleet swap's crash-recovery primitive:
+        after an aborted fleet swap, plain :meth:`rollback` would walk
+        one step back in history — which may be the just-retired standby
+        of an *earlier* aborted swap, not the version that was actually
+        serving. Naming the target makes convergence exact."""
+        with self._cv:
+            idx = next(
+                (
+                    i for i, e in enumerate(self._history)
+                    if e.version == version
+                ),
+                None,
+            )
+            if idx is None:
+                raise ServeError(f"version {version!r} not in history")
+            if self._active_idx == idx:
+                return version
+            old = (
+                None if self._active_idx is None
+                else self._history[self._active_idx]
+            )
+            self._active_idx = idx
+            entry = self._history[idx]
+            entry.retired = False
+        REGISTRY.incr("serve/activations")
+        REGISTRY.set_gauge(
+            "langdetect_serve_model_version", float(idx), version=version
+        )
+        log_event(
+            _log, "serve.activate", version=version,
+            from_=old.version if old is not None else None,
+        )
+        if old is not None:
+            self._retire(old)
+        return version
 
     def _retire(self, entry: ModelVersion) -> None:
         """Drain ``entry`` (wait for in-flight leases, bounded) and mark

@@ -1,0 +1,640 @@
+"""Health-checked routing front tier for a replicated serving fleet.
+
+GSPMD's portability argument (PAPERS.md: arXiv:2105.04663) makes the
+*data plane* of replication free — the same compiled program serves
+identically on every replica. What is not free is the control plane this
+module supplies: deciding, per request, which replica is healthy enough
+and least loaded; noticing a replica die mid-flight and retrying the
+(idempotent) request elsewhere; ejecting a flapping replica and
+re-admitting it only after a half-open probe succeeds; and shedding
+fleet-wide only when *every* ready replica is saturated. One router
+thread-safe object owns all of it:
+
+  * **Probing.** A background loop (or explicit :meth:`probe_once` —
+    what the deterministic chaos tests drive) hits each replica's
+    ``/healthz/ready``. Liveness is "the probe was answered at all";
+    readiness is the replica's own report (breaker closed, not degraded,
+    not draining — the server's split ``/healthz`` surface). Reachability
+    feeds a per-replica :class:`~..resilience.policy.CircuitBreaker`:
+    ``failure_threshold`` consecutive failed probes/dispatches eject the
+    replica (breaker open — no traffic, no probes) until the cooldown
+    elapses, then ONE half-open probe decides re-admission. The
+    ``fleet/probe`` fault site injects probe failures deterministically.
+  * **Routing.** Least outstanding rows among eligible replicas (ready,
+    not draining, breaker closed, version matching the fleet pin when one
+    is set), with the replica *index* as the deterministic tie-break —
+    two routers fed the same sequence make the same choices.
+  * **Failover.** A dispatch that dies mid-flight (connection refused or
+    reset, HTTP 5xx, an injected ``fleet/dispatch`` fault) is classified
+    by the same retryable taxonomy the serving layers use and retried on
+    a different replica — a per-request exclusion set guarantees the
+    retry never lands on the replica it just watched die. Scoring is
+    idempotent (pure read), so replays are safe by construction. 400 and
+    504 propagate untouched: the replica answered, the answer is final.
+  * **Fleet-wide shed.** A 503-shed from a replica means "healthy but
+    saturated": the router tries the remaining ready replicas and only
+    when every one of them shed does it raise :class:`FleetSaturated`
+    (HTTP 503 + the smallest ``Retry-After`` any replica offered).
+
+The version pin is the router's half of the two-phase fleet hot-swap
+(:mod:`.fleet`, docs/SERVING.md §9): while a swap is in flight, only
+replicas serving the pinned version are eligible, which is what keeps a
+client stream from ever interleaving two model versions.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.client import HTTPException
+
+from ..exec import config as exec_config
+from ..resilience import faults
+from ..resilience.policy import CLOSED, HALF_OPEN, OPEN, CircuitBreaker, is_retryable
+from ..telemetry import REGISTRY, span
+from ..utils.logging import get_logger, log_event
+from .batcher import INTERACTIVE, LANES, ServeError, ServeOverloaded
+from .client import ServeClient, ServeHTTPError
+from .server import JsonHTTPFront
+
+_log = get_logger("serve.router")
+
+
+class FleetSaturated(ServeOverloaded):
+    """Every ready replica shed the request: the fleet as a whole is out
+    of capacity. Maps to HTTP 503 + Retry-After like any other shed."""
+
+
+class NoReadyReplica(ServeOverloaded):
+    """No replica is currently eligible (all ejected, draining, or
+    mid-swap): an explicit, retryable rejection — never a hang."""
+
+
+class FleetSwapError(ServeError):
+    """A fleet-wide two-phase swap aborted (phase 1) or rolled back
+    (phase 2). The fleet is back on one consistent version."""
+
+
+class ReplicaHandle:
+    """Router-side view of one replica: address, health, load.
+
+    Mutable state (``ready``/``reasons``/``draining``/``version``/
+    ``outstanding_rows``) is guarded by the router's lock; the breaker
+    has its own.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        host: str,
+        port: int,
+        *,
+        breaker: CircuitBreaker,
+        request_timeout_s: float,
+        probe_timeout_s: float,
+    ):
+        self.name = name
+        self.host, self.port = host, port
+        self.client = ServeClient(host, port, timeout_s=request_timeout_s)
+        self.probe_client = ServeClient(host, port, timeout_s=probe_timeout_s)
+        self.breaker = breaker
+        self.ready = False
+        self.reasons: list[str] = ["unprobed"]
+        self.draining = False  # router-side: the fleet swap's drain mark
+        self.version: str | None = None
+        self.outstanding_rows = 0
+
+    def describe(self) -> dict:
+        return {
+            "replica": self.name,
+            "address": f"{self.host}:{self.port}",
+            "ready": self.ready,
+            "reasons": list(self.reasons),
+            "draining": self.draining,
+            "version": self.version,
+            "outstanding_rows": self.outstanding_rows,
+            "breaker": self.breaker.state,
+        }
+
+
+def _as_endpoint(i: int, rep) -> tuple[str, str, int]:
+    """(name, host, port) from a ServeReplica-like object or a tuple."""
+    if hasattr(rep, "address"):
+        host, port = rep.address
+        return getattr(rep, "name", f"r{i}"), host, int(port)
+    host, port = rep
+    return f"r{i}", host, int(port)
+
+
+class FleetRouter:
+    """Routing front tier over N serve replicas (docs/SERVING.md §9).
+
+    ``replicas``: :class:`~.fleet.ServeReplica` objects or bare
+    ``(host, port)`` tuples — the router only ever talks HTTP, so a
+    replica may live in this process, another process, or another host.
+    Knobs resolve through the audited config precedence
+    (``LANGDETECT_FLEET_*`` — exec/config.py).
+    """
+
+    def __init__(
+        self,
+        replicas,
+        *,
+        probe_interval_ms: float | None = None,
+        probe_timeout_s: float | None = None,
+        dispatch_attempts: int | None = None,
+        breaker_threshold: int | None = None,
+        breaker_cooldown_s: float | None = None,
+        drain_timeout_s: float | None = None,
+        request_timeout_s: float = 60.0,
+        name: str = "fleet",
+    ):
+        self.name = name
+        self.probe_interval_s = float(exec_config.resolve(
+            "fleet_probe_interval_ms", probe_interval_ms
+        )) / 1000.0
+        self.probe_timeout_s = float(exec_config.resolve(
+            "fleet_probe_timeout_s", probe_timeout_s
+        ))
+        self.dispatch_attempts = int(exec_config.resolve(
+            "fleet_dispatch_attempts", dispatch_attempts
+        ))
+        self.drain_timeout_s = float(exec_config.resolve(
+            "fleet_drain_timeout_s", drain_timeout_s
+        ))
+        threshold = int(exec_config.resolve(
+            "fleet_breaker_threshold", breaker_threshold
+        ))
+        cooldown = float(exec_config.resolve(
+            "fleet_breaker_cooldown_s", breaker_cooldown_s
+        ))
+        self._lock = threading.Lock()
+        self._pin: str | None = None
+        self._handles: list[ReplicaHandle] = []
+        for i, rep in enumerate(replicas):
+            rname, host, port = _as_endpoint(i, rep)
+            self._handles.append(ReplicaHandle(
+                rname, host, port,
+                breaker=CircuitBreaker(
+                    failure_threshold=threshold, cooldown_s=cooldown,
+                    name=f"{name}:{rname}",
+                ),
+                request_timeout_s=request_timeout_s,
+                probe_timeout_s=self.probe_timeout_s,
+            ))
+        if not self._handles:
+            raise ValueError("a fleet router needs at least one replica")
+        self._started = time.monotonic()
+        self._stop = threading.Event()
+        self._probe_thread: threading.Thread | None = None
+        log_event(
+            _log, "fleet.router.start", replicas=len(self._handles),
+            probe_interval_ms=self.probe_interval_s * 1e3,
+            dispatch_attempts=self.dispatch_attempts,
+        )
+
+    # ---------------------------------------------------------- lifecycle ---
+    def start(self, *, probe: bool = True) -> "FleetRouter":
+        """Run one synchronous probe round (so routing works immediately),
+        then start the background prober unless ``probe=False`` (tests
+        drive :meth:`probe_once` explicitly for determinism)."""
+        self.probe_once()
+        if probe and self._probe_thread is None:
+            self._probe_thread = threading.Thread(
+                target=self._probe_loop, name=f"{self.name}-prober",
+                daemon=True,
+            )
+            self._probe_thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=10.0)
+            self._probe_thread = None
+
+    def __enter__(self) -> "FleetRouter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            try:
+                self.probe_once()
+            except Exception as e:  # the prober must survive anything
+                log_event(_log, "fleet.probe_loop_error", error=repr(e))
+
+    # ------------------------------------------------------------ probing ---
+    def probe_once(self) -> list[str]:
+        """One probe round over every replica, in index order (which is
+        what makes chaos plans at ``fleet/probe`` replay exactly).
+
+        Returns compact event strings (``"r1:unreachable:ejected"``,
+        ``"r1:readmitted"``, …) — the deterministic-replay tests pin
+        sequences of these.
+        """
+        events: list[str] = []
+        with span("fleet/probe", replicas=len(self._handles)):
+            for h in self._handles:
+                evt = self._probe_replica(h)
+                if evt:
+                    events.append(evt)
+        REGISTRY.incr("fleet/probe_rounds")
+        REGISTRY.set_gauge(
+            "langdetect_fleet_ready_replicas", float(len(self.eligible()))
+        )
+        return events
+
+    def _probe_replica(self, h: ReplicaHandle) -> str | None:
+        if not h.breaker.allow():
+            # Open and still cooling down: stays ejected, unprobed. Once
+            # the cooldown elapses allow() flips to half-open and the
+            # probe below becomes the re-admission probe.
+            with self._lock:
+                h.ready = False
+                h.reasons = ["ejected"]
+            self._replica_gauges(h)
+            return None
+        before = h.breaker.state
+        try:
+            faults.inject("fleet/probe")
+            payload = h.probe_client.readyz()
+        except Exception as e:
+            h.breaker.record_failure()
+            # Only the CLOSED -> OPEN edge is an ejection *event*; a
+            # failed half-open re-probe re-opens the breaker but is the
+            # same outage continuing — counting it would make the
+            # regression-guarded counter proportional to outage length.
+            ejected = h.breaker.state == OPEN and before == CLOSED
+            if ejected:
+                REGISTRY.incr("fleet/ejections")
+            with self._lock:
+                h.ready = False
+                h.reasons = ["unreachable"]
+            self._replica_gauges(h)
+            log_event(
+                _log, "fleet.probe_failed", replica=h.name, error=repr(e),
+                ejected=ejected,
+            )
+            return f"{h.name}:unreachable" + (":ejected" if ejected else "")
+        # Reachable: liveness proven, which is what the router-side
+        # breaker tracks. Readiness is the replica's own report and does
+        # NOT trip the breaker — honest backpressure is not a crash.
+        h.breaker.record_success()
+        readmitted = before in (OPEN, HALF_OPEN) and h.breaker.state == CLOSED
+        if readmitted:
+            REGISTRY.incr("fleet/readmissions")
+            log_event(_log, "fleet.readmitted", replica=h.name)
+        ready = bool(payload.get("ready"))
+        with self._lock:
+            h.ready = ready
+            h.reasons = list(
+                payload.get("reasons") or ([] if ready else ["not_ready"])
+            )
+            h.version = payload.get("version") or h.version
+        self._replica_gauges(h)
+        if readmitted:
+            return f"{h.name}:readmitted"
+        return f"{h.name}:ready" if ready else f"{h.name}:not_ready"
+
+    def _replica_gauges(self, h: ReplicaHandle) -> None:
+        REGISTRY.set_gauge(
+            "langdetect_fleet_replica_ready",
+            1.0 if (h.ready and h.breaker.state == CLOSED) else 0.0,
+            replica=h.name,
+        )
+
+    # ------------------------------------------------------------ routing ---
+    def _eligible_locked(self, h: ReplicaHandle) -> bool:
+        return (
+            h.ready
+            and not h.draining
+            and h.breaker.state == CLOSED
+            and (self._pin is None or h.version == self._pin)
+        )
+
+    def eligible(self) -> list[str]:
+        with self._lock:
+            return [
+                h.name for h in self._handles if self._eligible_locked(h)
+            ]
+
+    def _pick(self, rows: int, excluded: set) -> ReplicaHandle | None:
+        """Least outstanding rows among eligible replicas; replica index
+        breaks ties deterministically. Reserves ``rows`` on the winner."""
+        with self._lock:
+            best: tuple[tuple[int, int], ReplicaHandle] | None = None
+            for idx, h in enumerate(self._handles):
+                if h.name in excluded or not self._eligible_locked(h):
+                    continue
+                key = (h.outstanding_rows, idx)
+                if best is None or key < best[0]:
+                    best = (key, h)
+            if best is None:
+                return None
+            h = best[1]
+            h.outstanding_rows += rows
+            REGISTRY.set_gauge(
+                "langdetect_fleet_outstanding_rows",
+                float(h.outstanding_rows), replica=h.name,
+            )
+            return h
+
+    def _release(self, h: ReplicaHandle, rows: int) -> None:
+        with self._lock:
+            h.outstanding_rows = max(0, h.outstanding_rows - rows)
+            REGISTRY.set_gauge(
+                "langdetect_fleet_outstanding_rows",
+                float(h.outstanding_rows), replica=h.name,
+            )
+
+    def _note_dispatch_failure(self, h: ReplicaHandle, exc: Exception) -> None:
+        before = h.breaker.state
+        h.breaker.record_failure()
+        ejected = h.breaker.state == OPEN and before == CLOSED
+        if ejected:
+            REGISTRY.incr("fleet/ejections")
+            with self._lock:
+                h.ready = False
+                h.reasons = ["dispatch_failures"]
+            self._replica_gauges(h)
+        REGISTRY.incr("fleet/failovers")
+        log_event(
+            _log, "fleet.failover", replica=h.name, error=repr(exc),
+            ejected=ejected,
+        )
+
+    def score(self, texts, **kw):
+        """(float32 [N, L] scores, response metadata incl. ``replica``)."""
+        return self._dispatch(list(texts), want_labels=False, **kw)
+
+    def detect(self, texts, **kw):
+        """(labels, response metadata incl. ``replica``)."""
+        return self._dispatch(list(texts), want_labels=True, **kw)
+
+    def _dispatch(
+        self,
+        texts: list,
+        *,
+        want_labels: bool,
+        priority: str = INTERACTIVE,
+        deadline_ms: float | None = None,
+        trace_id: str | None = None,
+    ):
+        rows = len(texts)
+        excluded: set[str] = set()
+        saturated: list[float] = []
+        t0 = time.perf_counter()
+        attempt = 0
+        while attempt < self.dispatch_attempts:
+            h = self._pick(rows, excluded)
+            if h is None:
+                break
+            attempt += 1
+            try:
+                with span(
+                    "fleet/dispatch", replica=h.name, rows=rows,
+                    attempt=attempt,
+                ):
+                    faults.inject("fleet/dispatch")
+                    if want_labels:
+                        out, meta = h.client.detect(
+                            texts, priority=priority, deadline_ms=deadline_ms
+                        )
+                    else:
+                        out, meta = h.client.score(
+                            texts, priority=priority,
+                            deadline_ms=deadline_ms, trace_id=trace_id,
+                        )
+            except ServeHTTPError as e:
+                self._release(h, rows)
+                if e.status == 503 and e.shed:
+                    # Healthy but saturated: not a failure, but this
+                    # request must try the rest of the fleet.
+                    saturated.append(e.retry_after_s)
+                    excluded.add(h.name)
+                    REGISTRY.incr("fleet/replica_saturated")
+                    continue
+                if e.status == 503 or (e.status >= 500 and e.status != 504):
+                    # Closed mid-stop, internal error: the replica is in
+                    # trouble — failover, and never retry on it.
+                    excluded.add(h.name)
+                    self._note_dispatch_failure(h, e)
+                    continue
+                # 400/404/504: the replica ANSWERED — a bad request stays
+                # bad and a blown deadline's answer is already worthless
+                # (replaying it elsewhere would bill healthy replicas for
+                # dead-on-arrival work and mis-feed their breakers).
+                raise
+            except Exception as e:
+                self._release(h, rows)
+                if not (isinstance(e, HTTPException) or is_retryable(e)):
+                    raise
+                excluded.add(h.name)
+                self._note_dispatch_failure(h, e)
+                continue
+            self._release(h, rows)
+            REGISTRY.incr("fleet/requests")
+            REGISTRY.observe("fleet/request_s", time.perf_counter() - t0)
+            REGISTRY.observe("fleet/attempts_per_request", attempt)
+            meta["replica"] = h.name
+            return out, meta
+        # Exhausted. Every eligible replica either shed (saturated) or
+        # died under this request (excluded) — an explicit, retryable
+        # fleet-wide 503 either way, never a hang and never a drop the
+        # client can't recover with its Retry-After backoff.
+        REGISTRY.incr("fleet/shed_requests")
+        if saturated:
+            positive = [s for s in saturated if s > 0]
+            retry_after = min(positive) if positive else self.probe_interval_s
+            raise FleetSaturated(
+                f"every ready replica shed ({len(saturated)} saturated, "
+                f"{len(excluded) - len(saturated)} failed)",
+                reason="fleet_saturated",
+                retry_after_s=max(retry_after, 0.001),
+            )
+        raise NoReadyReplica(
+            f"no ready replica (eligible={self.eligible()}, "
+            f"excluded={sorted(excluded)})",
+            reason="no_ready_replica",
+            retry_after_s=max(
+                self.probe_interval_s * 2, self.probe_timeout_s / 2, 0.05
+            ),
+        )
+
+    # ---------------------------------------------- swap coordination hooks --
+    def pin_version(self, version: str | None) -> None:
+        """Restrict routing to replicas serving ``version`` (None clears).
+        The fleet swap pins the old version before the first flip and
+        moves the pin exactly once — the cutover — which is what makes
+        per-client-stream versions monotonic (docs/SERVING.md §9)."""
+        with self._lock:
+            self._pin = version
+        log_event(_log, "fleet.pin", version=version)
+
+    @property
+    def pinned_version(self) -> str | None:
+        with self._lock:
+            return self._pin
+
+    def set_draining(self, name: str, draining: bool) -> None:
+        h = self._handle(name)
+        with self._lock:
+            h.draining = draining
+
+    def note_version(self, name: str, version: str | None) -> None:
+        """Record a replica's serving version without waiting for the
+        next probe round (the fleet swap calls this at each flip)."""
+        h = self._handle(name)
+        with self._lock:
+            h.version = version
+
+    def outstanding(self, name: str) -> int:
+        h = self._handle(name)
+        with self._lock:
+            return h.outstanding_rows
+
+    def wait_drained(self, name: str, timeout_s: float | None = None) -> bool:
+        """Poll until no routed request is outstanding on ``name``."""
+        deadline = time.monotonic() + (
+            self.drain_timeout_s if timeout_s is None else timeout_s
+        )
+        while self.outstanding(name) > 0:
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.002)
+        return True
+
+    def _handle(self, name: str) -> ReplicaHandle:
+        for h in self._handles:
+            if h.name == name:
+                return h
+        raise ValueError(f"unknown replica {name!r}")
+
+    # ------------------------------------------------------------- status ---
+    def healthz(self) -> dict:
+        with self._lock:
+            replicas = [h.describe() for h in self._handles]
+            pin = self._pin
+        eligible = self.eligible()
+        return {
+            "ok": bool(eligible),
+            "router": True,
+            "ready_replicas": eligible,
+            "pinned_version": pin,
+            "replicas": replicas,
+            "uptime_s": round(time.monotonic() - self._started, 3),
+        }
+
+    def readyz(self) -> dict:
+        eligible = self.eligible()
+        return {
+            "ready": bool(eligible),
+            "reasons": [] if eligible else ["no_ready_replica"],
+            "version": self.pinned_version,
+            "ready_replicas": eligible,
+        }
+
+
+class RouterServer(JsonHTTPFront):
+    """HTTP front end for the router: the same JSON surface as one
+    replica (``/score`` ``/detect`` ``/healthz[/live|/ready]`` ``/varz``
+    ``/admin/swap`` ``/admin/rollback``), so :class:`~.client.ServeClient`
+    drives a fleet exactly like a single server — responses additionally
+    carry the serving ``replica``. Admin endpoints require an attached
+    :class:`~.fleet.ServeFleet` (they coordinate the two-phase swap).
+    """
+
+    thread_name = "fleet-http"
+
+    def __init__(
+        self,
+        router: FleetRouter,
+        *,
+        fleet=None,
+        host: str = "127.0.0.1",
+        port: int = 8000,
+        admin: bool = True,
+    ):
+        self.router = router
+        self.fleet = fleet
+        self.admin = admin
+        super().__init__(host, port)
+
+    # ---------------------------------------------------------- handlers ----
+    def score(self, payload: dict, *, labels: bool) -> dict:
+        texts = payload.get("texts", payload.get("docs"))
+        if not isinstance(texts, list) or not all(
+            isinstance(t, str) for t in texts
+        ):
+            raise ValueError('"texts" must be a list of strings')
+        priority = payload.get("priority", INTERACTIVE)
+        if priority not in LANES:
+            raise ValueError(
+                f"unknown priority {priority!r}; expected one of {LANES}"
+            )
+        deadline_ms = payload.get("deadline_ms")
+        if deadline_ms is not None:
+            deadline_ms = float(deadline_ms)
+        if labels:
+            out, meta = self.router.detect(
+                texts, priority=priority, deadline_ms=deadline_ms
+            )
+            meta["labels"] = out
+        else:
+            out, meta = self.router.score(
+                texts, priority=priority, deadline_ms=deadline_ms,
+                trace_id=payload.get("trace_id"),
+            )
+            # f32 -> f64 -> JSON double round-trips exactly, so routing
+            # through this tier stays bit-transparent end to end.
+            meta["scores"] = [[float(v) for v in row] for row in out]
+        return meta
+
+    def swap(self, payload: dict) -> dict:
+        if not self.admin:
+            raise ServeError("admin endpoints disabled")
+        if self.fleet is None:
+            raise ServeError("no fleet attached to this router front end")
+        path = payload.get("path")
+        if not isinstance(path, str) or not path:
+            raise ValueError('"path" must name a saved model directory')
+        version = self.fleet.swap(path, version=payload.get("version"))
+        return {"version": version}
+
+    def rollback(self) -> dict:
+        if not self.admin:
+            raise ServeError("admin endpoints disabled")
+        if self.fleet is None:
+            raise ServeError("no fleet attached to this router front end")
+        return {"version": self.fleet.rollback()}
+
+    def healthz(self) -> dict:
+        out = self.router.healthz()
+        out["draining"] = self._draining
+        out["uptime_s"] = round(time.monotonic() - self._started, 3)
+        return out
+
+    def readyz(self) -> dict:
+        out = self.router.readyz()
+        if self._draining:
+            out["ready"] = False
+            out["reasons"] = list(out.get("reasons") or []) + ["draining"]
+        out["draining"] = self._draining
+        return out
+
+    def varz(self) -> dict:
+        snap = REGISTRY.snapshot()
+        return {
+            "stages": REGISTRY.stage_summary(),
+            "counters": snap["counters"],
+            "gauges": snap["gauges"],
+            "histograms": {
+                name: h for name, h in snap["histograms"].items()
+                if not name.startswith(("span:", "span_device:"))
+            },
+            "fleet": self.router.healthz(),
+            "config": exec_config.effective_config(),
+        }

@@ -11,7 +11,16 @@ Endpoints (docs/SERVING.md §2):
   * ``POST /score``   ``{"texts": [...], "priority"?, "deadline_ms"?}``
     → ``{"scores": [[...]], "version", "trace_id", ...}``
   * ``POST /detect``  same request shape → ``{"labels": [...], ...}``
-  * ``GET  /healthz`` liveness + queue/breaker/version snapshot
+  * ``GET  /healthz`` combined snapshot (liveness + readiness + queue/
+    breaker/version detail)
+  * ``GET  /healthz/live``  liveness only: answers 200 whenever the
+    process can still serve HTTP at all
+  * ``GET  /healthz/ready`` readiness: 200 only when this replica should
+    receive traffic — 503 (with machine-readable ``reasons``) while the
+    runner's breaker is open, the degraded ladder is active, the server
+    is draining, or no model is installed. The distinction is what a
+    fleet router keys on (docs/SERVING.md §9): a degraded replica is
+    *live* but must not be routed to.
   * ``GET  /varz``    telemetry: stage summaries, counters, gauges, and
     the serve latency histograms
   * ``POST /admin/swap``     ``{"path": "<model dir>"}`` → hot-swap
@@ -31,6 +40,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+from contextlib import contextmanager
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..exec import config as exec_config
@@ -45,6 +55,7 @@ from .batcher import (
     ServeError,
     ServeOverloaded,
 )
+from .client import ServeHTTPError
 from .registry import ModelRegistry
 
 _log = get_logger("serve.server")
@@ -85,6 +96,13 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if self.path == "/healthz":
                 self._reply(200, self.server.healthz())
+            elif self.path == "/healthz/live":
+                self._reply(200, self.server.livez())
+            elif self.path == "/healthz/ready":
+                payload = self.server.readyz()
+                # k8s convention: a not-ready replica answers the probe
+                # (it is live) but with 503, so dumb LBs drop it too.
+                self._reply(200 if payload.get("ready") else 503, payload)
             elif self.path == "/varz":
                 self._reply(200, self.server.varz())
             else:
@@ -93,6 +111,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(500, {"error": repr(e)})
 
     def do_POST(self):
+        # Tracked so stop() can drain: an accepted request is answered
+        # before the batcher is torn down (the zero-loss stop contract).
+        with self.server.track_request():
+            self._do_post_tracked()
+
+    def _do_post_tracked(self):
         try:
             payload = self._read_json()
         except json.JSONDecodeError as e:  # before ValueError: its subclass
@@ -122,13 +146,130 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(504, {"error": str(e), "deadline": True})
         except ServeClosed as e:
             self._reply(503, {"error": str(e), "closed": True})
+        except ServeHTTPError as e:
+            # A replica's own verdict surfacing through the router front
+            # (a 400/504 the router rightly refuses to retry): mirror the
+            # status, payload, and Retry-After instead of flattening it
+            # to a 500 — the front presents the same surface as one
+            # replica.
+            payload = (
+                e.payload if isinstance(e.payload, dict)
+                else {"error": str(e)}
+            )
+            headers = {}
+            for k, v in (e.headers or {}).items():
+                if k.lower() == "retry-after":
+                    headers["Retry-After"] = v
+            self._reply(e.status, payload, headers)
         except (ValueError, KeyError) as e:
             self._reply(400, {"error": repr(e)})
         except Exception as e:
             self._reply(500, {"error": repr(e)})
 
 
-class ServingServer(ThreadingHTTPServer):
+class JsonHTTPFront(ThreadingHTTPServer):
+    """Shared lifecycle for the JSON front ends (one serving replica or
+    the fleet router): daemon serve thread, in-flight request tracking,
+    and a draining ``stop()`` — mark draining (readiness flips false),
+    stop accepting, wait for accepted requests to be answered, only then
+    tear the backend down. Subclasses implement the handler surface
+    (``score``/``swap``/``rollback``/``healthz``/``readyz``/``varz``)
+    and ``_teardown``.
+    """
+
+    daemon_threads = True
+    thread_name = "serve-http"
+
+    def __init__(self, host: str, port: int):
+        self._started = time.monotonic()
+        self._thread: threading.Thread | None = None
+        self._draining = False
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
+        super().__init__((host, port), _Handler)
+
+    # --------------------------------------------------------- lifecycle ----
+    @property
+    def address(self) -> tuple[str, int]:
+        return self.server_address[0], self.server_address[1]
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @contextmanager
+    def track_request(self):
+        """Count one in-flight HTTP request (the handler wraps every POST
+        in this) so a draining stop knows when every accepted request has
+        been answered."""
+        with self._inflight_cv:
+            self._inflight += 1
+        try:
+            yield
+        finally:
+            with self._inflight_cv:
+                self._inflight -= 1
+                self._inflight_cv.notify_all()
+
+    def start(self):
+        """Serve on a daemon thread; returns self (``with`` works too)."""
+        self._thread = threading.Thread(
+            target=self.serve_forever, name=self.thread_name, daemon=True
+        )
+        self._thread.start()
+        log_event(_log, "serve.http.start", host=self.address[0],
+                  port=self.address[1])
+        return self
+
+    def stop(self, *, drain: bool = True, drain_timeout_s: float = 30.0):
+        """Stop serving. With ``drain`` (the default) this is hitless for
+        accepted work: readiness flips false first (a router stops
+        sending), the listener stops accepting, every in-flight request
+        is answered, and only then is the backend torn down — a stop
+        issued mid-burst loses zero accepted requests (pinned by
+        ``tests/test_fleet.py``). ``drain=False`` is the abrupt path
+        (crash drills): queued requests fail explicitly, never hang."""
+        self._draining = True
+        self.shutdown()
+        if drain:
+            deadline = time.monotonic() + drain_timeout_s
+            with self._inflight_cv:
+                while self._inflight > 0:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        log_event(
+                            _log, "serve.http.drain_timeout",
+                            inflight=self._inflight, port=self.address[1],
+                        )
+                        break
+                    self._inflight_cv.wait(min(remaining, 0.2))
+        self._teardown(drain)
+        self.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+        log_event(_log, "serve.http.stop", port=self.address[1],
+                  drained=drain)
+
+    def _teardown(self, drain: bool) -> None:  # subclass hook
+        pass
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ----------------------------------------------------------- probes -----
+    def livez(self) -> dict:
+        """Liveness: answering at all is the signal; the body is detail."""
+        return {
+            "live": True,
+            "draining": self._draining,
+            "uptime_s": round(time.monotonic() - self._started, 3),
+        }
+
+
+class ServingServer(JsonHTTPFront):
     """HTTP front end bound to a registry + batcher.
 
     ``registry`` may be a :class:`~.registry.ModelRegistry` or a fitted
@@ -136,8 +277,6 @@ class ServingServer(ThreadingHTTPServer):
     batcher defaults to env-tuned knobs; pass one to share it with
     in-process callers. ``port=0`` binds an ephemeral port (tests).
     """
-
-    daemon_threads = True
 
     def __init__(
         self,
@@ -156,39 +295,11 @@ class ServingServer(ThreadingHTTPServer):
         self._own_batcher = batcher is None
         self.batcher = batcher or ContinuousBatcher(registry, **batcher_kw)
         self.admin = admin
-        self._started = time.monotonic()
-        self._thread: threading.Thread | None = None
-        super().__init__((host, port), _Handler)
+        super().__init__(host, port)
 
-    # --------------------------------------------------------- lifecycle ----
-    @property
-    def address(self) -> tuple[str, int]:
-        return self.server_address[0], self.server_address[1]
-
-    def start(self) -> "ServingServer":
-        """Serve on a daemon thread; returns self (``with`` works too)."""
-        self._thread = threading.Thread(
-            target=self.serve_forever, name="serve-http", daemon=True
-        )
-        self._thread.start()
-        log_event(_log, "serve.http.start", host=self.address[0],
-                  port=self.address[1])
-        return self
-
-    def stop(self) -> None:
-        self.shutdown()
-        self.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=10.0)
+    def _teardown(self, drain: bool) -> None:
         if self._own_batcher:
-            self.batcher.close()
-        log_event(_log, "serve.http.stop", port=self.address[1])
-
-    def __enter__(self) -> "ServingServer":
-        return self.start()
-
-    def __exit__(self, *exc) -> None:
-        self.stop()
+            self.batcher.close(drain=drain)
 
     # ---------------------------------------------------------- handlers ----
     def score(self, payload: dict, *, labels: bool) -> dict:
@@ -252,9 +363,45 @@ class ServingServer(ThreadingHTTPServer):
             raise ServeError("admin endpoints disabled")
         return {"version": self.registry.rollback()}
 
+    def readyz(self) -> dict:
+        """Readiness: should this replica receive traffic *right now*?
+
+        Not ready (with a reason) while the server is draining, the
+        runner's breaker is anything but closed, the degraded ladder is
+        active, or no model is installed. Liveness is deliberately
+        looser — a degraded replica is alive (it answers, exactly, via
+        the fallback ladder) but a router with healthy alternatives
+        should prefer them (docs/SERVING.md §9)."""
+        reasons: list[str] = []
+        version = None
+        if self._draining:
+            reasons.append("draining")
+        try:
+            entry = self.registry.peek()
+            version = entry.version
+            runner = entry.runner
+            breaker = getattr(runner, "breaker", None)
+            state = breaker.state if breaker is not None else "closed"
+            if state != "closed":
+                reasons.append(f"breaker_{state}")
+            if getattr(runner, "_degraded_mode", False):
+                reasons.append("degraded")
+        except ServeError:
+            reasons.append("no_model")
+        return {
+            "ready": not reasons,
+            "reasons": reasons,
+            "version": version,
+            "draining": self._draining,
+        }
+
     def healthz(self) -> dict:
+        ready = self.readyz()
         out = {
             "ok": True,
+            "ready": ready["ready"],
+            "reasons": ready["reasons"],
+            "draining": self._draining,
             "uptime_s": round(time.monotonic() - self._started, 3),
             "batcher": self.batcher.stats(),
         }

@@ -191,12 +191,14 @@ def capture_stats(events: list[dict]) -> dict:
                 if isinstance(v, dict) and v.get("count")
             }
         # Recovery-behavior counters (retries, breaker trips, DLQ rows,
-        # degraded batches, serve sheds/deadline rejections): a regression
-        # here is a reliability story even when every latency percentile
-        # held steady, so the guard diffs them like any other metric
-        # (docs/RESILIENCE.md §7, docs/SERVING.md §6). Only the serving
-        # counters that measure *rejection* regress — throughput counters
-        # like serve/coalesced_rows legitimately grow with load.
+        # degraded batches, serve sheds/deadline rejections, fleet
+        # failovers/ejections/swap aborts): a regression here is a
+        # reliability story even when every latency percentile held
+        # steady, so the guard diffs them like any other metric
+        # (docs/RESILIENCE.md §7, docs/SERVING.md §6, §9). Only the
+        # counters that measure *rejection or recovery* regress —
+        # throughput counters like serve/coalesced_rows (and good-news
+        # fleet counters like fleet/readmissions) legitimately grow.
         cpayload = ev.get("counters")
         if isinstance(cpayload, dict):
             counters = {
@@ -209,6 +211,11 @@ def capture_stats(events: list[dict]) -> dict:
                         "stream/retries",
                         "serve/deadline_rejects",
                         "serve/dispatch_errors",
+                        "serve/client_retries",
+                        "fleet/failovers",
+                        "fleet/ejections",
+                        "fleet/shed_requests",
+                        "fleet/swap_aborts",
                     )
                 )
             }

@@ -1,0 +1,665 @@
+"""Replicated serving fleet: router health/failover, two-phase fleet swap.
+
+The acceptance contract (ISSUE 9): a replica killed mid-traffic causes
+zero dropped responses (mid-flight failures are retried on a surviving
+replica, never on the replica the request just watched die), the killed
+replica is ejected and re-admitted through a half-open probe, the
+fleet-wide hot-swap is two-phase (any prepare failure aborts everywhere;
+a mid-commit crash rolls back to one consistent version) with every
+response answered by exactly one version and no client stream ever
+interleaving versions, and the ``fleet/*`` chaos sites replay
+deterministically — same seed, same failover/ejection sequence.
+"""
+
+import functools
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_languagedetector_tpu import LanguageDetectorModel
+from spark_languagedetector_tpu.ops.encoding import texts_to_bytes
+from spark_languagedetector_tpu.resilience import faults
+from spark_languagedetector_tpu.resilience.faults import FaultPlan
+from spark_languagedetector_tpu.resilience.policy import CircuitBreaker, RetryPolicy
+from spark_languagedetector_tpu.serve import ContinuousBatcher, ModelRegistry
+from spark_languagedetector_tpu.serve.batcher import ServeOverloaded
+from spark_languagedetector_tpu.serve.client import ServeClient, ServeHTTPError
+from spark_languagedetector_tpu.serve.fleet import ServeFleet
+from spark_languagedetector_tpu.serve.router import (
+    FleetSaturated,
+    FleetSwapError,
+    NoReadyReplica,
+    RouterServer,
+)
+from spark_languagedetector_tpu.serve.server import ServingServer
+from spark_languagedetector_tpu.telemetry import REGISTRY
+
+LANGS = ("x", "y")
+GRAM_KEYS = (b"ab", b"bc", b"zz", b"abc")
+TEXTS = ["abab", "zz", "abczz"]
+
+
+@functools.lru_cache(maxsize=None)
+def _model(seed=0):
+    # Cached per seed: a runner's jit programs compile per instance, so
+    # sharing model objects across tests is what keeps this module
+    # inside the tier-1 budget. Tests that mutate runner state (breaker,
+    # degraded flag) restore it before returning.
+    rng = np.random.default_rng(seed)
+    gram_map = {g: rng.normal(size=2).tolist() for g in GRAM_KEYS}
+    return LanguageDetectorModel.from_gram_map(gram_map, (2, 3), LANGS)
+
+
+def _models(seed, n=3):
+    # The shared-object form ServeFleet.from_path uses: one copy of the
+    # weights per process, replicas isolating serving state.
+    return [_model(seed)] * n
+
+
+ROUTER_KW = dict(
+    probe_interval_ms=30.0, probe_timeout_s=2.0, dispatch_attempts=3,
+    breaker_threshold=2, breaker_cooldown_s=0.15, drain_timeout_s=5.0,
+)
+
+
+def _fleet(seed=1, *, router_kw=None, **batcher_kw):
+    batcher_kw.setdefault("max_wait_ms", 2)
+    batcher_kw.setdefault("max_rows", 64)
+    return ServeFleet(
+        _models(seed), router_kw={**ROUTER_KW, **(router_kw or {})},
+        **batcher_kw,
+    )
+
+
+@pytest.fixture()
+def fleet():
+    fl = _fleet()
+    fl.start(probe=False)  # tests drive probe_once() deterministically
+    try:
+        yield fl
+    finally:
+        fl.close()
+
+
+def _counter(name):
+    return int(REGISTRY.snapshot()["counters"].get(name, 0))
+
+
+# ----------------------------------------------------- liveness/readiness ---
+def test_healthz_split_liveness_vs_readiness():
+    """/healthz/live answers 200 whenever the process is up; /healthz/ready
+    flips to 503 (with reasons) on breaker-open, degraded, and draining —
+    the states a router must not route to."""
+    registry = ModelRegistry()
+    registry.install(_model(5))
+    runner = registry.peek().runner
+    with ServingServer(registry, port=0, max_wait_ms=2) as server:
+        client = ServeClient(*server.address)
+        assert client.livez()["live"]
+        ready = client.readyz()
+        assert ready["ready"] and ready["reasons"] == []
+        assert ready["version"] == "v1"
+
+        # Breaker open: live, NOT ready, and the raw status is 503.
+        old_breaker = runner.breaker
+        runner.breaker = CircuitBreaker(
+            failure_threshold=1, cooldown_s=60.0, name="t"
+        )
+        runner.breaker.record_failure()
+        ready = client.readyz()
+        assert not ready["ready"] and "breaker_open" in ready["reasons"]
+        with pytest.raises(ServeHTTPError) as exc:
+            client._request_once("GET", "/healthz/ready")
+        assert exc.value.status == 503
+        assert client.livez()["live"]  # liveness unaffected
+        runner.breaker = old_breaker
+
+        # Degraded ladder active: live, not ready.
+        runner._degraded_mode = True
+        assert "degraded" in client.readyz()["reasons"]
+        runner._degraded_mode = False
+
+        # Draining: live, not ready; the combined /healthz reports both.
+        server._draining = True
+        ready = client.readyz()
+        assert not ready["ready"] and "draining" in ready["reasons"]
+        health = client.healthz()
+        assert health["ok"] and not health["ready"] and health["draining"]
+        server._draining = False
+        assert client.readyz()["ready"]
+
+
+def test_server_stop_drains_inflight_zero_loss():
+    """A stop() issued mid-burst answers every accepted request before
+    tearing down the batcher — zero accepted requests lost."""
+
+    class SlowRunner:
+        def __init__(self, runner):
+            self.runner = runner
+            self.calls = 0
+            self.breaker = None
+
+        def score(self, docs):
+            self.calls += 1
+            time.sleep(0.1)
+            return self.runner.score(docs)
+
+        def predict_ids(self, docs):
+            return self.runner.predict_ids(docs)
+
+    registry = ModelRegistry()
+    registry.install(_model(6))
+    runner = registry.peek().runner
+    slow = SlowRunner(runner)
+    batcher = ContinuousBatcher(slow, max_wait_ms=1, max_rows=4)
+    server = ServingServer(registry, port=0, batcher=batcher).start()
+    client = ServeClient(*server.address)
+    texts = ["abab", "zz"]
+    want = runner.score(texts_to_bytes(texts))
+    n = 8
+    results: list = [None] * n
+    errors: list = []
+
+    def work(i):
+        try:
+            scores, meta = client.score(texts)
+            results[i] = scores
+        except Exception as e:  # noqa: BLE001 - the test asserts none
+            errors.append(f"request {i}: {e!r}")
+
+    REGISTRY.reset()
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    # Wait until every request is ACCEPTED (admitted into the batcher),
+    # so the zero-loss claim is unambiguous — then stop mid-burst, with
+    # the earliest dispatches still in flight on the slow runner.
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if _counter("serve/admitted_requests") >= n:
+            break
+        time.sleep(0.005)
+    assert _counter("serve/admitted_requests") >= n
+    server.stop()  # drains: every accepted request answered
+    for t in threads:
+        t.join(timeout=30)
+    batcher.close()
+    assert not errors, errors[:3]
+    for i, scores in enumerate(results):
+        assert scores is not None, f"request {i} dropped"
+        np.testing.assert_array_equal(scores, want)
+
+
+# ------------------------------------------------------- client retries -----
+def test_client_retries_503_with_retry_after_bounded():
+    """ServeClient with a retry policy absorbs a transient shed (sleeping
+    max(Retry-After, seeded backoff)), stays bounded under a persistent
+    shed, and never retries 400."""
+    registry = ModelRegistry()
+    registry.install(_model(7))
+    runner = registry.peek().runner
+    with ServingServer(registry, port=0, max_wait_ms=2) as server:
+        host, port = server.address
+        client = ServeClient(host, port, retry_policy=RetryPolicy(
+            max_attempts=3, base_delay_s=0.01, seed=7,
+        ))
+        REGISTRY.reset()
+        with faults.plan_scope(FaultPlan.parse("seed=3;serve/admit:error@1")):
+            scores, meta = client.score(TEXTS)  # shed once, then served
+        np.testing.assert_array_equal(
+            scores, runner.score(texts_to_bytes(TEXTS))
+        )
+        assert _counter("serve/client_retries") == 1
+
+        # Bounded attempts: a persistent shed still raises, after
+        # max_attempts - 1 retries.
+        REGISTRY.reset()
+        with faults.plan_scope(
+            faults.FaultPlan.parse("seed=3;serve/admit:error@1-999")
+        ):
+            with pytest.raises(ServeHTTPError) as exc:
+                client.score(TEXTS)
+        assert exc.value.status == 503 and exc.value.shed
+        assert _counter("serve/client_retries") == 2
+
+        # 400 is the caller's bug: never retried.
+        REGISTRY.reset()
+        with pytest.raises(ServeHTTPError) as exc:
+            client._request(
+                "POST", "/score", {"texts": "not-a-list"}, idempotent=True
+            )
+        assert exc.value.status == 400
+        assert _counter("serve/client_retries") == 0
+
+
+def test_client_never_retries_504_deadline():
+    class SleepyRunner:
+        def __init__(self, runner):
+            self.runner = runner
+            self.calls = 0
+            self.breaker = None
+
+        def score(self, docs):
+            self.calls += 1
+            time.sleep(0.3)
+            return self.runner.score(docs)
+
+        def predict_ids(self, docs):
+            self.calls += 1
+            return self.runner.predict_ids(docs)
+
+    registry = ModelRegistry()
+    registry.install(_model(8))
+    slow = SleepyRunner(registry.peek().runner)
+    batcher = ContinuousBatcher(slow, max_wait_ms=1, max_rows=8)
+    server = ServingServer(registry, port=0, batcher=batcher).start()
+    try:
+        host, port = server.address
+        client = ServeClient(host, port, retry_policy=RetryPolicy(
+            max_attempts=4, base_delay_s=0.01, seed=1,
+        ))
+        blocker = threading.Thread(
+            target=lambda: ServeClient(host, port).score(["abab"] * 4)
+        )
+        REGISTRY.reset()
+        blocker.start()
+        for _ in range(400):  # wait until the dispatcher is actually busy
+            if slow.calls:
+                break
+            time.sleep(0.005)
+        with pytest.raises(ServeHTTPError) as exc:
+            client.score(["zz"], deadline_ms=1.0)
+        blocker.join(timeout=30)
+        assert exc.value.status == 504
+        assert _counter("serve/client_retries") == 0  # 504 is final
+    finally:
+        server.stop()
+        batcher.close()
+
+
+# ------------------------------------------------- registry two-phase -------
+def test_registry_prepare_commit_two_phase():
+    """prepare() is serving-invisible; commit() is the only flip; a
+    version-name conflict is caught at commit time."""
+    registry = ModelRegistry()
+    v1 = registry.install(_model(1))
+    prep = registry.prepare(_model(2))
+    assert registry.current_version() == v1  # nothing flipped yet
+    v2 = registry.commit(prep)
+    assert registry.current_version() == v2 == "v2"
+    dup = registry.prepare(_model(3), version="v2")
+    with pytest.raises(Exception, match="already registered"):
+        registry.commit(dup)
+    assert registry.current_version() == v2  # failed commit changed nothing
+
+
+# ------------------------------------------------------------- routing -----
+def test_router_least_outstanding_with_deterministic_tie_break(fleet):
+    router = fleet.router
+    assert router.eligible() == ["r0", "r1", "r2"]
+    h0 = router._pick(4, set())
+    assert h0.name == "r0"  # all-idle tie: lowest replica index
+    h1 = router._pick(4, set())
+    assert h1.name == "r1"  # r0 now carries 4 outstanding rows
+    h2 = router._pick(2, set())
+    assert h2.name == "r2"
+    h3 = router._pick(1, set())
+    assert h3.name == "r2"  # 2 rows < 4: still the least loaded
+    for h, rows in ((h0, 4), (h1, 4), (h2, 2), (h3, 1)):
+        router._release(h, rows)
+    assert router.outstanding("r2") == 0
+    # The per-request exclusion set is honored even at a tie.
+    h = router._pick(1, {"r0"})
+    assert h.name == "r1"
+    router._release(h, 1)
+
+
+def test_router_http_parity_and_failover_on_killed_replica(fleet):
+    """End-to-end over sockets: scores bit-identical to the direct
+    runner; after an abrupt replica kill the next request (which the
+    idle-fleet tie-break MUST route to the dead replica first) fails
+    over to a survivor — answered exactly once, never on the dead one."""
+    front = RouterServer(fleet.router, fleet=fleet, port=0).start()
+    try:
+        client = ServeClient(*front.address)
+        runner = fleet.replicas[0].registry.peek().runner
+        want = runner.score(texts_to_bytes(TEXTS))
+        scores, meta = client.score(TEXTS)
+        np.testing.assert_array_equal(scores, want)
+        assert meta["version"] == "v1" and meta["replica"] == "r0"
+
+        # A caller-side 400 answers as 400 through the front tier (never
+        # flattened to 500, never a failover — the answer is final).
+        REGISTRY.reset()
+        with pytest.raises(ServeHTTPError) as exc:
+            client._request("POST", "/score", {"texts": ["a"],
+                                               "priority": "vip"})
+        assert exc.value.status == 400
+        assert _counter("fleet/failovers") == 0
+
+        fleet.replica("r0").kill()
+        scores, meta = client.score(TEXTS)  # routed r0 -> dies -> failover
+        np.testing.assert_array_equal(scores, want)
+        assert meta["replica"] != "r0"
+        assert _counter("fleet/failovers") >= 1
+        labels, meta = client.detect(TEXTS)
+        ids = runner.predict_ids(texts_to_bytes(TEXTS))
+        assert labels == [LANGS[int(i)] for i in ids]
+    finally:
+        front.stop()
+
+
+def test_router_ejection_then_half_open_readmission(fleet):
+    """A dead replica is ejected after `breaker_threshold` failed probes,
+    stays ejected through the cooldown, and is re-admitted by exactly one
+    successful half-open probe after revival."""
+    REGISTRY.reset()
+    fleet.replica("r0").kill()
+    ev1 = fleet.router.probe_once()
+    assert "r0:unreachable" in ev1
+    ev2 = fleet.router.probe_once()
+    assert "r0:unreachable:ejected" in ev2  # threshold=2
+    assert "r0" not in fleet.router.eligible()
+    assert _counter("fleet/ejections") == 1
+    # Cooling down: no probe reaches the replica, it stays ejected.
+    ev3 = fleet.router.probe_once()
+    assert not any(e.startswith("r0") for e in ev3)
+    assert "r0" not in fleet.router.eligible()
+
+    # A FAILED half-open probe (still dead past the cooldown) re-opens
+    # the breaker but is the same outage continuing — the ejection
+    # counter must not inflate with outage length.
+    time.sleep(0.2)
+    ev_fail = fleet.router.probe_once()
+    assert "r0:unreachable" in ev_fail  # half-open probe failed...
+    assert _counter("fleet/ejections") == 1  # ...but no new ejection
+
+    fleet.replica("r0").revive()
+    time.sleep(0.2)  # cooldown 0.15s: the next probe is the half-open one
+    ev4 = fleet.router.probe_once()
+    assert "r0:readmitted" in ev4
+    assert fleet.router.eligible() == ["r0", "r1", "r2"]
+    assert _counter("fleet/readmissions") == 1
+
+
+def test_router_sheds_fleet_wide_only_when_every_replica_saturated(fleet):
+    """A single saturated replica is routed around; only when EVERY ready
+    replica sheds does the router answer with a fleet-wide 503."""
+    REGISTRY.reset()
+    # One replica sheds (the first one tried): the request lands on r1.
+    with faults.plan_scope(FaultPlan.parse("seed=2;serve/admit:error@1")):
+        scores, meta = fleet.router.score(TEXTS)
+    assert meta["replica"] == "r1"
+    assert _counter("fleet/replica_saturated") == 1
+    assert _counter("fleet/shed_requests") == 0
+    # Every replica sheds: explicit fleet-wide rejection with Retry-After.
+    with faults.plan_scope(FaultPlan.parse("seed=2;serve/admit:error@1-999")):
+        with pytest.raises(FleetSaturated) as exc:
+            fleet.router.score(TEXTS)
+    assert exc.value.reason == "fleet_saturated"
+    assert exc.value.retry_after_s > 0
+    assert _counter("fleet/shed_requests") == 1
+
+
+def test_router_no_ready_replica_is_explicit(fleet):
+    for rep in fleet.replicas:
+        rep.kill()
+    for _ in range(2):  # threshold=2: both rounds fail every replica
+        fleet.router.probe_once()
+    assert fleet.router.eligible() == []
+    with pytest.raises(NoReadyReplica) as exc:
+        fleet.router.score(TEXTS)
+    assert exc.value.reason == "no_ready_replica"
+    assert exc.value.retry_after_s > 0
+
+
+# ------------------------------------------------------ two-phase swap ------
+def test_fleet_swap_atomic_under_concurrent_traffic(fleet):
+    """Concurrent traffic across a fleet-wide swap: zero drops, every
+    response answered by exactly one version with that version's exact
+    scores, and no client stream ever sees the old version after its
+    first new-version response."""
+    fleet.router.start()  # background prober for this live test
+    runner_v1 = _model(1)._get_runner()
+    runner_v2 = _model(2)._get_runner()
+    want = {
+        "v1": runner_v1.score(texts_to_bytes(TEXTS)),
+        "v2": runner_v2.score(texts_to_bytes(TEXTS)),
+    }
+    n_threads = 4
+    streams: list[list] = [[] for _ in range(n_threads)]
+    errors: list[str] = []
+    started = threading.Barrier(n_threads + 1)
+    stop = threading.Event()
+
+    def work(i):
+        started.wait(timeout=10)
+        while not stop.is_set():
+            try:
+                scores, meta = fleet.router.score(TEXTS)
+            except ServeOverloaded:
+                time.sleep(0.01)  # transient: retry like a real client
+                continue
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+                return
+            streams[i].append((meta["version"], scores))
+            time.sleep(0.005)
+
+    threads = [
+        threading.Thread(target=work, args=(i,)) for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    started.wait(timeout=10)
+    time.sleep(0.05)  # let old-version traffic land first
+    v2 = fleet.swap(models=_models(2))
+    time.sleep(0.2)  # and new-version traffic after
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+
+    assert not errors, errors[:3]
+    assert v2 == "v2"
+    assert fleet.versions() == {"r0": "v2", "r1": "v2", "r2": "v2"}
+    served = set()
+    for i, stream in enumerate(streams):
+        seen_new = False
+        for version, scores in stream:
+            served.add(version)
+            np.testing.assert_array_equal(scores, want[version])
+            if version == "v2":
+                seen_new = True
+            else:
+                assert not seen_new, (
+                    f"stream {i} interleaved v1 after v2"
+                )
+    assert "v2" in served  # the swap took traffic
+
+
+def test_fleet_swap_refuses_concurrent_coordinator(fleet):
+    """One swap/rollback at a time: a second coordinator fails fast
+    instead of interleaving flips (two racing swaps could wedge the pin
+    on a version no replica serves)."""
+    assert fleet._swap_lock.acquire(blocking=False)
+    try:
+        with pytest.raises(FleetSwapError, match="in progress"):
+            fleet.swap(models=_models(2))
+        with pytest.raises(FleetSwapError, match="in progress"):
+            fleet.rollback()
+    finally:
+        fleet._swap_lock.release()
+    assert fleet.versions() == {"r0": "v1", "r1": "v1", "r2": "v1"}
+
+
+def test_fleet_swap_phase1_failure_aborts_everywhere(fleet):
+    """Any prepare failure aborts the swap on EVERY replica: nothing
+    flips, the old version keeps serving."""
+    REGISTRY.reset()
+    with faults.plan_scope(FaultPlan.parse("seed=1;fleet/swap:error@2")):
+        with pytest.raises(FleetSwapError, match="phase 1"):
+            fleet.swap(models=_models(2))
+    assert fleet.versions() == {"r0": "v1", "r1": "v1", "r2": "v1"}
+    assert fleet.router.pinned_version == "v1"
+    assert _counter("fleet/swap_aborts") == 1
+    scores, meta = fleet.router.score(TEXTS)
+    assert meta["version"] == "v1"
+    np.testing.assert_array_equal(
+        scores, _model(1)._get_runner().score(texts_to_bytes(TEXTS))
+    )
+
+
+def _crash_phase2(fl):
+    """Run the deterministic mid-phase-2 crash: 3 prepares (calls 1-3),
+    commit r0 (call 4) succeeds, commit r1 (call 5) crashes."""
+    with faults.plan_scope(FaultPlan.parse("seed=1;fleet/swap:error@5")):
+        with pytest.raises(FleetSwapError, match="phase 2") as exc:
+            fl.swap(models=_models(2))
+    return str(exc.value), fl.versions(), fl.router.pinned_version
+
+
+def test_fleet_swap_phase2_crash_rolls_back_and_replays(fleet):
+    """A crash mid-phase-2 rolls every flipped replica back — the fleet
+    converges to ONE version on either side of the failure — and the
+    same plan/seed replays to the identical outcome. A later clean swap
+    then succeeds (the fleet is not wedged)."""
+    REGISTRY.reset()
+    msg_a, versions_a, pin_a = _crash_phase2(fleet)
+    assert versions_a == {"r0": "v1", "r1": "v1", "r2": "v1"}
+    assert pin_a == "v1"
+    assert "rolled back" in msg_a
+    # r0 flipped and rolled back: v2 sits retired in its history.
+    r0_hist = [v["version"] for v in fleet.replicas[0].registry.versions()]
+    assert r0_hist == ["v1", "v2"]
+    scores, meta = fleet.router.score(TEXTS)
+    assert meta["version"] == "v1"
+
+    # Deterministic replay: same seed => same crash point, same outcome.
+    msg_b, versions_b, pin_b = _crash_phase2(fleet)
+    assert (msg_b, versions_b, pin_b) == (msg_a, versions_a, pin_a)
+    assert _counter("fleet/swap_aborts") == 2
+
+    # And the fleet is not wedged: a clean swap lands everywhere.
+    v_next = fleet.swap(models=_models(3))
+    assert set(fleet.versions().values()) == {v_next}
+    assert fleet.router.pinned_version == v_next
+
+
+def test_fleet_http_swap_rollback_and_healthz(fleet, tmp_path):
+    """Admin swap/rollback through the router's HTTP front end, fleet
+    health visible over the wire."""
+    front = RouterServer(fleet.router, fleet=fleet, port=0).start()
+    try:
+        client = ServeClient(*front.address)
+        model_b = _model(9)
+        model_b.save(str(tmp_path / "m2"))
+        runner_b = model_b._get_runner()
+        v2 = client.swap(str(tmp_path / "m2"))
+        assert v2 == "v2"
+        scores, meta = client.score(TEXTS)
+        assert meta["version"] == "v2"
+        np.testing.assert_array_equal(
+            scores, runner_b.score(texts_to_bytes(TEXTS))
+        )
+        health = client.healthz()
+        assert health["pinned_version"] == "v2"
+        assert [r["replica"] for r in health["replicas"]] == [
+            "r0", "r1", "r2"
+        ]
+        assert all(r["version"] == "v2" for r in health["replicas"])
+        assert client.readyz()["ready"]
+        assert client.rollback() == "v1"
+        _, meta = client.score(TEXTS)
+        assert meta["version"] == "v1"
+    finally:
+        front.stop()
+
+
+# -------------------------------------------------- deterministic chaos -----
+def _probe_sequence():
+    fl = _fleet(router_kw=dict(breaker_cooldown_s=30.0))
+    try:
+        seqs = []
+        with faults.plan_scope(
+            FaultPlan.parse("seed=7;fleet/probe:error%0.4")
+        ):
+            for _ in range(6):
+                seqs.append(tuple(fl.router.probe_once()))
+        return seqs
+    finally:
+        fl.close()
+
+
+def test_chaos_fleet_probe_replays_deterministically():
+    """Same %prob plan + seed on a fresh fleet => the identical
+    unreachable/ejection sequence (the schedule hashes (seed, site,
+    call), not wall-clock or process state)."""
+    a = _probe_sequence()
+    b = _probe_sequence()
+    assert a == b
+    flat = [e for s in a for e in s]
+    assert any("unreachable" in e for e in flat)  # the plan actually fired
+    assert any("ejected" in e for e in flat)
+
+
+def _dispatch_sequence():
+    fl = _fleet(router_kw=dict(breaker_threshold=5, breaker_cooldown_s=30.0))
+    fl.start(probe=False)
+    try:
+        served = []
+        with faults.plan_scope(
+            FaultPlan.parse("seed=7;fleet/dispatch:error@1,4")
+        ):
+            for _ in range(4):
+                scores, meta = fl.router.score(TEXTS)
+                served.append(
+                    (meta["replica"], _counter("fleet/failovers"))
+                )
+        return served
+    finally:
+        fl.close()
+
+
+def test_chaos_fleet_dispatch_replays_deterministically():
+    """fleet/dispatch faults at fixed call numbers produce the identical
+    failover sequence on a fresh fleet: attempt 1 dies on r0 -> served by
+    r1; later the counter schedule hits r0 again."""
+    REGISTRY.reset()
+    a = _dispatch_sequence()
+    REGISTRY.reset()
+    b = _dispatch_sequence()
+    assert a == b
+    # Request 1: dispatch call 1 fires on r0 -> failover -> r1 serves.
+    assert a[0] == ("r1", 1)
+    # Request 2: call 3 clean on the (idle-tie) r0.
+    assert a[1][0] == "r0"
+    # Request 3: call 4 fires on r0 again -> r1 serves, failovers == 2.
+    assert a[2] == ("r1", 2)
+    assert a[3][1] == 2  # request 4 clean
+
+
+# ------------------------------------------------------- bench smoke gate ---
+def test_bench_smoke_fleet_trimmed(tmp_path):
+    """Tier-1-sized fleet smoke: the full kill/eject/readmit/swap drill
+    with trimmed load, hard-gated exactly like the CI gate."""
+    import bench
+
+    result = bench.smoke_fleet(str(tmp_path / "fleet.jsonl"), trimmed=True)
+    assert result["ok"], result
+    assert result["dropped_responses"] == 0
+    assert result["argmax_parity"] == 1.0
+    assert result["failovers"] >= 1
+    assert result["ejections"] >= 1 and result["readmissions"] >= 1
+    assert result["swap"]["interleaved_streams"] == 0
+
+
+@pytest.mark.slow
+def test_bench_smoke_fleet_full(tmp_path):
+    import bench
+
+    result = bench.smoke_fleet(str(tmp_path / "fleet_full.jsonl"))
+    assert result["ok"], result
+    assert sorted(result["swap"]["versions_served"]) == ["v1", "v2"]
+    assert len(result["health"]["ready_replicas"]) == 3

@@ -461,6 +461,74 @@ def test_registry_load_from_disk_and_duplicate_version(tmp_path):
     )
 
 
+def test_registry_rollback_racing_concurrent_install():
+    """Rollback racing a concurrent install: every in-flight request is
+    answered by exactly one version (lease pinning), and the history
+    walk stays consistent — exactly one active entry, every version name
+    unique, the active pointer always inside the history."""
+    model_a, model_b, model_c = _model(seed=31), _model(seed=32), _model(33)
+    runners = {}
+    registry = ModelRegistry(drain_timeout_s=0.2)
+    runners["v1"] = model_a._get_runner()
+    registry.install(model_a)  # v1
+    runners["v2"] = model_b._get_runner()
+    registry.install(model_b)  # v2
+    docs = [b"abab", b"zz"]
+    want = {v: r.score(docs) for v, r in runners.items()}
+
+    stop = threading.Event()
+    failures: list[str] = []
+
+    def traffic():
+        while not stop.is_set():
+            with registry.lease() as entry:
+                got = entry.runner.score(docs)
+                if entry.version in want and not np.array_equal(
+                    got, want[entry.version]
+                ):
+                    failures.append(
+                        f"version {entry.version} answered foreign scores"
+                    )
+
+    def installer():
+        runners["v3"] = model_c._get_runner()
+        registry.install(model_c)
+
+    def roller():
+        try:
+            registry.rollback()
+        except Exception:
+            pass  # racing a flip may leave nothing to roll back to
+
+    workers = [threading.Thread(target=traffic) for _ in range(3)]
+    for t in workers:
+        t.start()
+    racers = [threading.Thread(target=installer),
+              threading.Thread(target=roller)]
+    for t in racers:
+        t.start()
+    for t in racers:
+        t.join(timeout=30)
+    stop.set()
+    for t in workers:
+        t.join(timeout=30)
+
+    want["v3"] = runners["v3"].score(docs)
+    assert not failures, failures[:3]
+    versions = registry.versions()
+    names = [v["version"] for v in versions]
+    assert len(names) == len(set(names))  # history never duplicates
+    assert sum(v["active"] for v in versions) == 1  # exactly one active
+    active = next(v for v in versions if v["active"])
+    # The active version always answers its own scores after the dust
+    # settles (whatever interleaving the race produced).
+    with registry.lease() as entry:
+        assert entry.version == active["version"]
+        np.testing.assert_array_equal(
+            entry.runner.score(docs), want[entry.version]
+        )
+
+
 def test_registry_lease_pins_version_during_swap():
     """A lease taken before a swap keeps serving its version; the next
     lease sees the new one."""

@@ -572,7 +572,7 @@ def fit_compute_only(cfg, langs, docs, labels, reps=6):
         spec = VocabSpec(EXACT, low)
     lang_to_idx = {l: i for i, l in enumerate(langs)}
     lang_idx = np.asarray([lang_to_idx[l] for l in labels], dtype=np.int32)
-    items, item_langs, plan, _ = plan_fit_batches(
+    items, item_langs, plan, _, _ = plan_fit_batches(
         texts_to_bytes(docs), lang_idx, spec
     )
     if not plan:
@@ -962,7 +962,7 @@ def telemetry_block(jsonl_path: str) -> dict:
     REGISTRY.flush()
     from spark_languagedetector_tpu.exec import config as exec_config
 
-    return {
+    out = {
         "jsonl": jsonl_path,
         "stages": REGISTRY.stage_summary(),
         # The audited effective config (same block /varz serves): every
@@ -970,6 +970,27 @@ def telemetry_block(jsonl_path: str) -> dict:
         # exactly which lattice/budget/window produced its numbers.
         "effective_config": exec_config.effective_config(),
     }
+    # Redundancy-eliminator evidence (docs/PERFORMANCE.md §10): present
+    # whenever this config's run saw dedup or serve-cache traffic.
+    counters = REGISTRY.snapshot()["counters"]
+    rows_in = int(counters.get("dedup/rows_in", 0))
+    lookups = int(counters.get("cache/lookups", 0))
+    if rows_in or lookups:
+        out["redundancy"] = {
+            "dedup_rows_in": rows_in,
+            "dedup_rows_unique": int(counters.get("dedup/rows_unique", 0)),
+            "dedup_unique_ratio": round(
+                int(counters.get("dedup/rows_unique", 0)) / rows_in, 6
+            ) if rows_in else None,
+            "cache_lookups": lookups,
+            "cache_hits": int(counters.get("cache/hits", 0)),
+            "cache_hit_rate": round(
+                int(counters.get("cache/hits", 0)) / lookups, 6
+            ) if lookups else None,
+            "bytes_saved": int(counters.get("dedup/bytes_saved", 0))
+            + int(counters.get("cache/bytes_saved", 0)),
+        }
+    return out
 
 
 def smoke_telemetry(jsonl_path: str | None = None) -> dict:
@@ -1988,6 +2009,350 @@ def smoke_tune(jsonl_path: str | None = None) -> dict:
     return result
 
 
+def smoke_cache(jsonl_path: str | None = None, *, trimmed: bool = False) -> dict:
+    """CPU-safe redundancy-eliminator smoke (docs/PERFORMANCE.md §10).
+
+    Drives a Zipf-duplicated corpus (~70% duplicate mass — the serve
+    traffic shape) through all three front ends with the two-level
+    eliminator on, and A/B's it against the dedup/cache-off baseline:
+
+      1. **batch** — the runner's in-flight dedup, interleaved on/off
+         timing passes; scores must stay bit-identical (gather strategy)
+         and the duplicated corpus must run ≥ 1.5× faster end-to-end;
+      2. **all-unique overhead** — the same A/B on a duplicate-free
+         corpus; the dict build + scatter must cost ≤ 3% end-to-end;
+      3. **stream** — ``run_stream`` over duplicated micro-batches with a
+         checkpoint, parity vs the dedup-off transform;
+      4. **fleet** — a 2-replica fleet behind the router front with
+         concurrent clients replaying duplicated texts through the
+         version-keyed serve cache, a fleet-wide two-phase hot-swap
+         mid-run; per-version score parity must be exactly 1.0 (a stale
+         cache answer — any pre-swap bits served post-swap — is a parity
+         mismatch by construction, because the two model versions are
+         fitted on different corpora), and the cache must demonstrably
+         hit.
+
+    ``trimmed=True`` is the tier-1-sized variant: smaller legs, and the
+    two wall-clock gates (speedup, overhead) are reported but not gated —
+    tier-1 runs on noisy shared CPUs where a 3% timing bound would flake;
+    the full run is the CI gate.
+    """
+    import tempfile
+    import threading
+
+    from spark_languagedetector_tpu import LanguageDetector, Table
+    from spark_languagedetector_tpu.ops.encoding import texts_to_bytes
+    from spark_languagedetector_tpu.serve.client import ServeClient, ServeHTTPError
+    from spark_languagedetector_tpu.serve.fleet import ServeFleet
+    from spark_languagedetector_tpu.serve.router import RouterServer
+    from spark_languagedetector_tpu.stream.microbatch import memory_source, run_stream
+    from spark_languagedetector_tpu.telemetry import REGISTRY
+    from spark_languagedetector_tpu.telemetry.export import JsonlSink
+
+    REGISTRY.reset()
+    path = jsonl_path or os.path.join(
+        tempfile.gettempdir(), f"cache_smoke_{os.getpid()}.jsonl"
+    )
+    sink = JsonlSink(path)
+    REGISTRY.add_sink(sink)
+    errors: list[str] = []
+
+    # gram_lengths [1,2,3] keep every runner on the gather strategy: the
+    # geometry-stable A/B reference, so dedup scatter-back and cached
+    # results are bit-identical to the baseline (docs/SERVING.md §1).
+    langs = language_names(3)
+    docs, labels = make_corpus(langs, 60, mean_len=200, seed=3)
+    model_a = LanguageDetector(langs, [1, 2, 3], 200).fit(
+        Table({"lang": labels, "fulltext": docs})
+    )
+    docs_b, labels_b = make_corpus(langs, 60, mean_len=200, seed=9)
+    model_b = LanguageDetector(langs, [1, 2, 3], 150).fit(
+        Table({"lang": labels_b, "fulltext": docs_b})
+    )
+    runner = model_a._get_runner()
+
+    # Zipf-duplicated workload at ~70% duplicate mass (the acceptance
+    # shape): every pool document appears at least once (so distinct/total
+    # is exactly the pool fraction) and the remaining 70% of the corpus is
+    # drawn from the pool under a Zipf law — the heavy-tailed repetition
+    # real serve traffic shows (trending content, retries, short texts).
+    n_zipf = 300 if trimmed else 1200
+    n_pool = max(2, int(n_zipf * 0.3))
+    pool_raw, _ = make_corpus(langs, n_pool, mean_len=200, seed=21)
+    # Suffix-tag the pool so its members are pairwise distinct by
+    # construction (the tiny word lists can collide on short docs).
+    pool = [f"{t} p{i}" for i, t in enumerate(pool_raw)]
+    rng = np.random.default_rng(35)
+    zipf_p = _zipf_probs(n_pool, s=1.2)
+    zipf_texts = pool + [
+        pool[i] for i in rng.choice(n_pool, n_zipf - n_pool, p=zipf_p)
+    ]
+    zipf_texts = [zipf_texts[i] for i in rng.permutation(n_zipf)]
+    zipf_docs = texts_to_bytes(zipf_texts)
+    dup_mass = 1.0 - len(set(zipf_docs)) / len(zipf_docs)
+    # The overhead leg uses a larger corpus than the speedup leg: the 3%
+    # bound is tighter than one pass's scheduler jitter at small sizes,
+    # and the jitter is absolute (~fractions of a ms), so longer passes
+    # shrink it relative to the signal. Trimmed mode skips the leg
+    # entirely — neither wall-clock gate applies there, and the extra
+    # corpus' compile shapes would be pure tier-1 time.
+    uniq_docs = None
+    if not trimmed:
+        uniq_raw, _ = make_corpus(langs, 8 * n_zipf, mean_len=200, seed=43)
+        uniq_texts = [f"{t} u{i}" for i, t in enumerate(uniq_raw)]
+        uniq_docs = texts_to_bytes(uniq_texts)
+
+    def timed_pass(batch_docs, dedup_on: bool) -> tuple[float, np.ndarray]:
+        runner.dedup = dedup_on
+        t0 = time.perf_counter()
+        out = runner.score(batch_docs)
+        return time.perf_counter() - t0, out
+
+    # --- leg 1+2: batch A/B, interleaved passes, medians -------------------
+    import gc
+
+    reps = 3 if trimmed else 9
+    t_dup = {True: [], False: []}
+    t_uni = {True: [], False: []}
+    scores_on = scores_off = None
+    uni_on = uni_off = None
+    timed_pass(zipf_docs, True)  # warm the compile shapes off the clock
+    if uniq_docs is not None:
+        timed_pass(uniq_docs, True)
+
+    def ab_round(batch_docs, n_reps, on_times, off_times):
+        out_on = out_off = None
+        gc.collect()
+        # A collection (or any host hiccup) landing inside one pass skews
+        # it; the estimator below tolerates that, but don't invite it.
+        gc.disable()
+        try:
+            for _ in range(n_reps):
+                dt, out_on = timed_pass(batch_docs, True)
+                on_times.append(dt)
+                dt, out_off = timed_pass(batch_docs, False)
+                off_times.append(dt)
+        finally:
+            gc.enable()
+        return out_on, out_off
+
+    scores_on, scores_off = ab_round(
+        zipf_docs, reps, t_dup[True], t_dup[False]
+    )
+    if uniq_docs is not None:
+        uni_on, uni_off = ab_round(uniq_docs, reps, t_uni[True], t_uni[False])
+        # Shared-CPU pass times here are bimodal — an uncontended fast
+        # mode and a ~2x contended mode that persists across several
+        # passes — so paired ratios can land 2x off in either direction.
+        # min-of-each-side is the robust estimator: both sides hit the
+        # uncontended mode within a few reps, and a REAL dedup overhead
+        # shifts every on-pass, the minimum included. One retry round
+        # before declaring failure keeps a wholly-contended first round
+        # from flaking the gate; a genuine regression fails both.
+        overhead = float(min(t_uni[True]) / min(t_uni[False]) - 1.0)
+        if overhead > 0.03:
+            ab_round(uniq_docs, reps, t_uni[True], t_uni[False])
+            overhead = float(min(t_uni[True]) / min(t_uni[False]) - 1.0)
+    else:
+        overhead = None
+    runner.dedup = True
+    speedup = float(min(t_dup[False]) / min(t_dup[True]))
+    batch_bit_exact = bool(np.array_equal(scores_on, scores_off))
+    batch_parity = float(np.mean(
+        np.argmax(scores_on, axis=1) == np.argmax(scores_off, axis=1)
+    ))
+    if not batch_bit_exact:
+        errors.append("batch dedup scores not bit-identical on gather")
+    if batch_parity != 1.0:
+        errors.append(f"batch argmax parity {batch_parity:.6f} != 1.0")
+    if uniq_docs is not None and not np.array_equal(uni_on, uni_off):
+        errors.append("all-unique dedup pass changed scores")
+    if not trimmed and speedup < 1.5:
+        errors.append(
+            f"duplicated-corpus speedup {speedup:.2f}x < 1.5x"
+        )
+    if not trimmed and overhead > 0.03:
+        errors.append(f"all-unique overhead {overhead:.1%} > 3%")
+
+    # --- leg 3: stream with dedup + checkpoint -----------------------------
+    ck_path = os.path.join(
+        tempfile.gettempdir(), f"cache_smoke_ck_{os.getpid()}.json"
+    )
+    if os.path.exists(ck_path):
+        os.remove(ck_path)
+    stream_rows = [{"fulltext": t} for t in zipf_texts]
+    batch_rows = 64
+    got_tables: list = []
+    query = run_stream(
+        model_a, memory_source(stream_rows, batch_rows), got_tables.append,
+        checkpoint_path=ck_path,
+    )
+    stream_pred = [
+        v for tbl in got_tables for v in tbl.column("lang").tolist()
+    ]
+    runner.dedup = False
+    want_tbl = model_a.transform(Table({"fulltext": zipf_texts}))
+    runner.dedup = True
+    stream_want = want_tbl.column("lang").tolist()
+    stream_parity = float(np.mean(
+        np.asarray(stream_pred) == np.asarray(stream_want)
+    )) if stream_pred else 0.0
+    if stream_parity != 1.0:
+        errors.append(f"stream dedup parity {stream_parity:.6f} != 1.0")
+    if query.batches != -(-len(stream_rows) // batch_rows):
+        errors.append("stream did not sink every batch")
+
+    # --- leg 4: 2-replica fleet + cache + mid-run hot-swap -----------------
+    # In-memory models (ServeFleet's shared-object form): both runners are
+    # already compiled by the legs above, so the fleet leg measures cache/
+    # swap semantics, not 10+ seconds of fresh-instance jit compiles. The
+    # disk-load + /admin/swap HTTP path is smoke_fleet's gate.
+    runner_a = model_a._get_runner()
+    runner_b = model_b._get_runner()
+    runner_b.score(zipf_docs[:8])  # warm b's compile off the fleet clock
+
+    n_clients = 2 if trimmed else 4
+    rounds = 6 if trimmed else 12
+    docs_per_req = 4
+    swap_round = rounds // 2
+    v_old, v_new = "v1", [None]
+    barrier = threading.Barrier(n_clients)
+    lock = threading.Lock()
+    responses: list[tuple[list, np.ndarray, str]] = []
+
+    fleet = ServeFleet(
+        [model_a] * 2,
+        router_kw=dict(probe_interval_ms=40.0, probe_timeout_s=2.0),
+        max_wait_ms=4, max_rows=64, max_queue_rows=512,
+    ).start()
+    front = RouterServer(fleet.router, fleet=fleet, port=0).start()
+    host, port = front.address
+    try:
+        def drive(ci: int) -> None:
+            crng = np.random.default_rng(500 + ci)
+            client = ServeClient(host, port)
+            for r in range(rounds):
+                try:
+                    barrier.wait(timeout=60)
+                except threading.BrokenBarrierError:
+                    pass
+                if ci == 0 and r == swap_round:
+                    # The fleet's coordinated two-phase flip (prepare
+                    # everywhere, then drain+commit behind the version
+                    # pin) — same protocol /admin/swap drives.
+                    v_new[0] = fleet.swap(models=[model_b] * 2)
+                    continue
+                # All clients draw from the SAME duplicated pool — the
+                # cross-request hits are the point of the serve cache.
+                picks = crng.choice(len(zipf_texts), docs_per_req)
+                texts = [zipf_texts[int(i)] for i in picks]
+                try:
+                    scores, meta = client.score(texts)
+                except (ServeHTTPError, OSError) as e:
+                    with lock:
+                        errors.append(f"fleet client {ci} round {r}: {e}")
+                    continue
+                with lock:
+                    responses.append((texts, scores, meta["version"]))
+
+        threads = [
+            threading.Thread(target=drive, args=(ci,))
+            for ci in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+    finally:
+        front.stop()
+        fleet.close()
+
+    # Per-version bit parity = the zero-staleness gate: the versions are
+    # fitted on different corpora, so a cached pre-swap row served for a
+    # post-swap request cannot bit-match the post-swap runner.
+    stale = checked = 0
+    versions_served: set[str] = set()
+    for texts, scores, version in responses:
+        versions_served.add(version)
+        direct = (runner_a if version == v_old else runner_b).score(
+            texts_to_bytes(texts)
+        )
+        checked += 1
+        if scores.shape != direct.shape or not np.array_equal(scores, direct):
+            stale += 1
+    fleet_parity = 1.0 if checked and stale == 0 else (
+        round(1.0 - stale / checked, 6) if checked else 0.0
+    )
+    if fleet_parity != 1.0:
+        errors.append(
+            f"fleet per-version parity {fleet_parity} != 1.0 "
+            f"({stale} stale/mismatched responses)"
+        )
+    if v_new[0] is None or versions_served != {v_old, v_new[0]}:
+        errors.append(f"swap not observed (served {sorted(versions_served)})")
+
+    snap = REGISTRY.snapshot()
+    counters = snap["counters"]
+    hits = int(counters.get("cache/hits", 0))
+    lookups = int(counters.get("cache/lookups", 0))
+    hit_rate = hits / lookups if lookups else 0.0
+    rows_in = int(counters.get("dedup/rows_in", 0))
+    rows_unique = int(counters.get("dedup/rows_unique", 0))
+    wire_saved = int(counters.get("dedup/bytes_saved", 0)) + int(
+        counters.get("cache/bytes_saved", 0)
+    )
+    if hits <= 0:
+        errors.append("serve cache never hit under duplicated traffic")
+    if rows_unique >= rows_in or rows_in <= 0:
+        errors.append("in-flight dedup eliminated nothing")
+
+    result = {
+        "smoke_cache": True,
+        "trimmed": trimmed,
+        "duplicate_mass": round(dup_mass, 4),
+        "batch": {
+            "docs": n_zipf,
+            "speedup_duplicated": round(speedup, 3),
+            "overhead_all_unique": (
+                None if overhead is None else round(overhead, 4)
+            ),
+            "bit_exact": batch_bit_exact,
+            "argmax_parity": batch_parity,
+            "docs_per_s_on": round(n_zipf / float(np.min(t_dup[True])), 1),
+            "docs_per_s_off": round(n_zipf / float(np.min(t_dup[False])), 1),
+        },
+        "stream": {
+            "batches": query.batches,
+            "parity": stream_parity,
+        },
+        "fleet": {
+            "replicas": 2,
+            "answered": len(responses),
+            "per_version_parity": fleet_parity,
+            "stale_answers": stale,
+            "versions_served": sorted(versions_served),
+            "swap_to": v_new[0],
+        },
+        "cache": {
+            "hits": hits,
+            "lookups": lookups,
+            "hit_rate": round(hit_rate, 4),
+            "evictions": int(counters.get("cache/evictions", 0)),
+        },
+        "dedup": {
+            "rows_in": rows_in,
+            "rows_unique": rows_unique,
+            "unique_ratio": round(rows_unique / rows_in, 4) if rows_in else 1.0,
+        },
+        "wire_bytes_saved": wire_saved,
+        "errors": errors[:8],
+        "telemetry": telemetry_block(path),
+    }
+    result["ok"] = not errors
+    REGISTRY.remove_sink(sink)
+    return result
+
+
 def fit_scaling_probe(n_devices: int) -> dict:
     """Child half of the fit-scaling leg: run in a subprocess whose
     XLA_FLAGS forced ``n_devices`` virtual CPU devices. Fits the probe
@@ -2830,6 +3195,35 @@ def main():
             print(
                 "refit smoke FAILED: "
                 + ("; ".join(result["errors"]) or "gate not met"),
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        return
+    if "--smoke-cache" in sys.argv[1:]:
+        # Redundancy-eliminator smoke: Zipf-duplicated corpus through
+        # batch, stream, and a 2-replica fleet with a mid-run hot-swap.
+        # Gates: per-version parity exactly 1.0 with zero stale answers,
+        # demonstrated cache hits + dedup savings, >=1.5x on the
+        # duplicated corpus, <=3% overhead on all-unique traffic.
+        args = [a for a in sys.argv[1:] if a != "--smoke-cache"]
+        flags = [a for a in args if a.startswith("-")]
+        if flags or len(args) > 1:
+            print(
+                f"usage: python bench.py --smoke-cache [out.jsonl] "
+                f"(got {args})",
+                file=sys.stderr,
+            )
+            sys.exit(2)
+        result = smoke_cache(args[0] if args else None)
+        print(json.dumps(result), flush=True)
+        if not result["ok"]:
+            print(
+                "cache smoke FAILED: "
+                + (
+                    "; ".join(result["errors"])
+                    or "gate (parity/staleness/hit-rate/speedup/overhead) "
+                    "not met"
+                ),
                 file=sys.stderr,
             )
             sys.exit(1)

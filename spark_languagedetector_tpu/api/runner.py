@@ -31,6 +31,7 @@ import numpy as np
 
 from ..exec import config as exec_config
 from ..exec.core import (
+    dedup_counted,
     guarded_dispatch,
     plan_micro_batches,
     rows_under_byte_budget,
@@ -284,6 +285,17 @@ class BatchRunner:
     retry_policy: RetryPolicy | None = None
     breaker: CircuitBreaker | None = None
     degraded_fallback: bool | None = None
+    # In-flight content dedup (docs/PERFORMANCE.md §10): duplicate
+    # documents in one call are planned, shipped, and scored ONCE — the
+    # wire and the kernel see unique rows only — and every duplicate is
+    # satisfied by a deterministic scatter-back of the fetched result
+    # (``out = unique_out[inverse]``, so input order is exact). Scores of
+    # the surviving unique rows may ride a different batch geometry than
+    # an undeduped call's, which on matmul strategies can flip the last
+    # f32 bit (the reduction-order class in docs/ARCHITECTURE.md);
+    # gather/fused stay bit-exact. None ⇒ exec.config resolution
+    # (``LANGDETECT_DEDUP``, default on).
+    dedup: bool | None = None
     metrics: Metrics = field(default_factory=Metrics)
 
     def __post_init__(self):
@@ -311,6 +323,8 @@ class BatchRunner:
             # and the live behavior can't disagree ("false"/"off"/"no"
             # now disable it too, not just "0").
             self.degraded_fallback = bool(exec_config.resolve("degraded"))
+        if self.dedup is None:
+            self.dedup = bool(exec_config.resolve("dedup"))
         # True while the last dispatch rode the degradation ladder; drives
         # the langdetect_degraded gauge's reset on fast-path recovery.
         self._degraded_mode = False
@@ -1017,10 +1031,19 @@ class BatchRunner:
         if self.mesh is not None and jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
-            return np.asarray(
+            host = np.asarray(
                 multihost_utils.process_allgather(arr, tiled=True)
             )
-        return np.asarray(arr)
+        else:
+            host = np.asarray(arr)
+        # The d2h audit trail (docs/PERFORMANCE.md §10): every result byte
+        # the runner pulls off the device goes through here, so a label
+        # request silently re-fetching the full [B, L] score matrix shows
+        # up as a counter jump the tests pin (4·B ids + the chunked docs'
+        # few score rows is the contract on every strategy and ladder
+        # rung).
+        REGISTRY.incr("score/fetch_bytes", int(host.nbytes))
+        return host
 
     @staticmethod
     def _pack(batch_docs, pad_to: int):
@@ -1305,6 +1328,18 @@ class BatchRunner:
                 byte_docs = [truncate_utf8(d, cap) for d in byte_docs]
             else:
                 byte_docs = [d[:cap] for d in byte_docs]
+        N_in = len(byte_docs)
+        # In-flight dedup (docs/PERFORMANCE.md §10), keyed on the encoded,
+        # truncated bytes — the exact content the kernel would see. Unique
+        # rows ride the wire and the dispatch; duplicates are satisfied by
+        # the scatter-back at the very end (``out = out[inverse]``). The
+        # dict build is the whole all-unique overhead.
+        inverse = None
+        if self.dedup and N_in > 1:
+            d = dedup_counted(byte_docs)
+            if d is not None:
+                first_idx, inverse, _ = d
+                byte_docs = [byte_docs[int(i)] for i in first_idx]
         N = len(byte_docs)
         L = self.weights.shape[1]
         if want_labels:
@@ -1591,7 +1626,8 @@ class BatchRunner:
         # slow request can be isolated from the aggregate percentiles.
         with trace_request() as req_id, trace(label="score"), \
                 self.metrics.timer("score_s"), span(
-            "score", docs=N, batches=len(plan), strategy=self.strategy,
+            "score", docs=N_in, unique=N, batches=len(plan),
+            strategy=self.strategy,
             strategy_reason=getattr(self, "strategy_reason", "explicit"),
         ) as score_span:
             # The core's plan executor: serial, or a few threads
@@ -1703,12 +1739,20 @@ class BatchRunner:
             for i, r in chunk_rank.items():
                 out[i] = int(np.argmax(chunk_acc[r]))
 
-        self.metrics.incr("docs_scored", N)
+        if inverse is not None:
+            # Deterministic scatter-back: every duplicate reads its unique
+            # row's stored result — per-call parity with an undeduped run
+            # is bit-exact on geometry-stable strategies (gather/fused) and
+            # the usual reduction-order class on matmul strategies.
+            out = out[inverse]
+
+        self.metrics.incr("docs_scored", N_in)
         REGISTRY.observe("score/retries_per_call", len(call_retries))
         log_event(
             _log,
             "runner.score",
-            docs=N,
+            docs=N_in,
+            unique=N,
             chunks=len(chunks),
             batches=len(plan),
             trace_id=req_id,

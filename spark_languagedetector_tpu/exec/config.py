@@ -90,6 +90,18 @@ KNOBS: dict[str, Knob] = _knobs(
          positive=True),
     Knob("pack_threads", "LANGDETECT_PACK_THREADS", "int", None,
          "native packer thread count (unset: auto)", positive=True),
+    # --- redundancy elimination (docs/PERFORMANCE.md §10) -----------------
+    Knob("dedup", "LANGDETECT_DEDUP", "bool", True,
+         "in-flight content dedup: unique rows ride the wire/kernel, "
+         "duplicates scatter back from the fetched result"),
+    Knob("cache_enable", "LANGDETECT_CACHE_ENABLE", "bool", True,
+         "version-keyed serve score cache in front of the runner"),
+    Knob("cache_rows", "LANGDETECT_CACHE_ROWS", "int", 1 << 16,
+         "serve cache entry bound (documents)", tunable=True,
+         positive=True),
+    Knob("cache_bytes", "LANGDETECT_CACHE_BYTES", "int", 64 << 20,
+         "serve cache byte bound (keys + stored results)", tunable=True,
+         positive=True),
     # --- serving (tunable: flush window + shape bounds) -------------------
     Knob("serve_max_wait_ms", "LANGDETECT_SERVE_MAX_WAIT_MS", "float", 10.0,
          "serve coalescing window: max ms the oldest queued request "

@@ -90,6 +90,8 @@ TUNED_FIELDS["fit_batch_bytes"] = _positive_int("fit_batch_bytes")
 TUNED_FIELDS["serve_max_rows"] = _positive_int("serve_max_rows")
 TUNED_FIELDS["serve_queue_rows"] = _positive_int("serve_queue_rows")
 TUNED_FIELDS["serve_max_wait_ms"] = _positive_float("serve_max_wait_ms")
+TUNED_FIELDS["cache_rows"] = _positive_int("cache_rows")
+TUNED_FIELDS["cache_bytes"] = _positive_int("cache_bytes")
 
 
 @dataclass(frozen=True)

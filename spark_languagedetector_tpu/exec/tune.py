@@ -196,6 +196,55 @@ def solve_serve(signals: dict, *, p99_ms: float | None) -> dict:
     }
 
 
+# ---------------------------------------------------------- cache solver ----
+def solve_cache(signals: dict) -> dict:
+    """Serve-cache sizing from the capture's observed duplicate mass
+    (docs/PERFORMANCE.md §10).
+
+    Emits ``cache_rows``/``cache_bytes`` only when the capture proves
+    both (a) serve-cache traffic (``cache/lookups`` > 0 — the replay went
+    through a batcher with the cache on) and (b) actual duplicate mass:
+    either in the dedup counters (repeats inside a dispatch) or as cache
+    hits (repeats ACROSS dispatches — the steady-state shape once the
+    cache is warm, where repeats never reach the runner and the dedup
+    counters therefore read all-unique). An all-unique capture keeps the
+    built-in defaults through normal config fallback rather than
+    recording an unmeasured guess as "tuned".
+
+    Sizing: every miss during the capture window is one distinct
+    (version, mode, document) entry the cache had to hold, so the row
+    bound is the misses count with 2x headroom, rounded up to a power of
+    two (clamped [1024, 2^20]); the byte bound multiplies rows by the
+    measured mean SERVED-document size plus a flat result/overhead
+    allowance (clamped [1MB, 1GB]). The document size comes from the
+    cache's own traffic — ``cache/bytes_saved`` counts the hit documents'
+    bytes, so ``bytes_saved / hits`` is exactly the mean size of what the
+    cache stores; the dedup byte counters are NOT used here because they
+    aggregate the fit path too, which would bias the entry size toward
+    whatever corpus the capture happened to fit. Deterministic over the
+    capture.
+    """
+    counters = signals["counters"]
+    lookups = float(counters.get("cache/lookups") or 0.0)
+    hits = float(counters.get("cache/hits") or 0.0)
+    rows_in = float(counters.get("dedup/rows_in") or 0.0)
+    rows_unique = float(counters.get("dedup/rows_unique") or 0.0)
+    dedup_mass = rows_in > 0 and rows_unique < rows_in
+    if lookups <= 0 or not (dedup_mass or hits > 0):
+        return {}
+    misses = max(1.0, lookups - hits)
+    rows = 1024
+    while rows < 2 * misses and rows < (1 << 20):
+        rows *= 2
+    saved = float(counters.get("cache/bytes_saved") or 0.0)
+    mean_doc = saved / hits if hits > 0 else 0.0
+    per_entry = int(mean_doc) + 512  # result row + key/entry overhead
+    cache_bytes = 1 << 20
+    while cache_bytes < rows * per_entry and cache_bytes < (1 << 30):
+        cache_bytes *= 2
+    return {"cache_rows": int(rows), "cache_bytes": int(cache_bytes)}
+
+
 # --------------------------------------------------------- budget solver ----
 def solve_budgets(signals: dict, *, max_batch_ms: float | None) -> dict:
     """Per-transfer byte budgets. Without a latency constraint the
@@ -256,6 +305,7 @@ def solve(
     tuned: dict = {"length_buckets": buckets}
     tuned.update(solve_budgets(signals, max_batch_ms=max_batch_ms))
     tuned.update(solve_serve(signals, p99_ms=p99_ms))
+    tuned.update(solve_cache(signals))
 
     before = padded_bytes(bins, list(DEFAULT_LENGTH_BUCKETS))
     after = padded_bytes(bins, buckets)
@@ -266,11 +316,19 @@ def solve(
         "max_batch_ms": max_batch_ms,
         "p99_ms": p99_ms,
     }
+    counters = signals["counters"]
+    rows_in = float(counters.get("dedup/rows_in") or 0.0)
+    rows_unique = float(counters.get("dedup/rows_unique") or 0.0)
     source = {
         "events": signals["events"],
         "capture_span_s": round(signals["span_s"], 3),
         "items": int(sum(bins.values())),
         "len_bins": len(bins),
+        # Observed duplicate mass (the cache solver's evidence): fraction
+        # of submitted rows the dedup layer collapsed during the capture.
+        "duplicate_mass": (
+            round(1.0 - rows_unique / rows_in, 6) if rows_in > 0 else 0.0
+        ),
         "padded_bytes_default_lattice": int(before),
         "padded_bytes_tuned_lattice": int(after),
         "predicted_padded_reduction": (

@@ -53,6 +53,7 @@ import numpy as np
 
 from ..exec import config as exec_config
 from ..exec.core import (
+    dedup_counted,
     ordered_prefetch,
     plan_micro_batches,
     rows_under_byte_budget,
@@ -136,10 +137,11 @@ def plan_fit_batches(
     batch_rows: int | None = None,
     byte_budget: int = DEFAULT_FIT_BATCH_BYTES,
     length_buckets: Sequence[int] | None = None,
+    dedup: bool | None = None,
 ):
     """Deterministic micro-batch plan for the device fit's ingest.
 
-    Returns ``(items, item_langs, plan, straddle)``:
+    Returns ``(items, item_langs, plan, straddle, item_mult)``:
 
       * ``items`` / ``item_langs`` — the work rows: every document ≤ the
         largest bucket verbatim, oversized documents chunk-split
@@ -157,40 +159,72 @@ def plan_fit_batches(
         boundary windows severed by chunk-splitting (host-computed via
         ``spec.gram_to_id``), or None. Scatter-added once through the fit's
         ``extra_counts`` path, they make the split exactly count-preserving.
+      * ``item_mult`` — int32 per-item dedup multiplicity, or None when
+        every (doc, lang) pair is distinct (or ``dedup`` is off — env
+        ``LANGDETECT_DEDUP``). A duplicated source batch is counted once on
+        device with its windows weighted by the duplicate count — integer
+        counts × integer weight equals the duplicated sum exactly, so the
+        fitted profile stays bit-identical to the undeduped fit
+        (docs/PERFORMANCE.md §10).
     """
     if length_buckets is None:
         # The tuned lattice (exec.config: env > tuning profile > default) —
         # fit and score share one bucket set so the compiled shapes overlap.
         length_buckets = exec_config.resolve("length_buckets")
+    if dedup is None:
+        dedup = bool(exec_config.resolve("dedup"))
     max_len = length_buckets[-1]
     max_gram = max(spec.gram_lengths)
     lang_arr = np.asarray(lang_indices)
+    docs = [
+        d if isinstance(d, bytes) else bytes(d)  # native packer wants bytes
+        for d in byte_docs
+    ]
+    doc_mult = None
+    if dedup and len(docs) > 1:
+        d = dedup_counted(
+            [(doc, int(lang)) for doc, lang in zip(docs, lang_arr)],
+            size_of=lambda key: len(key[0]),
+        )
+        if d is not None:
+            first_idx, _, doc_mult = d
+            docs = [docs[int(i)] for i in first_idx]
+            lang_arr = np.asarray(lang_arr)[first_idx]
     items: list[bytes] = []
     item_langs: list[int] = []
+    item_mult: list[int] = []
     corr: dict[tuple[int, int], int] = {}
-    for doc, lang in zip(byte_docs, lang_arr):
+    for j, (doc, lang) in enumerate(zip(docs, lang_arr)):
         lang = int(lang)
-        if not isinstance(doc, bytes):
-            doc = bytes(doc)  # the native packer's c_char_p wants real bytes
+        m = 1 if doc_mult is None else int(doc_mult[j])
         if len(doc) <= max_len:
             items.append(doc)
             item_langs.append(lang)
+            item_mult.append(m)
             continue
         prev = 0
         for p in split_bounds(len(doc), max_len, max_gram):
             items.append(doc[prev:p])
             item_langs.append(lang)
+            item_mult.append(m)
             prev = p
             # Windows straddling this boundary (start in (p-n, p)) exist in
-            # no chunk; count them here. n = 1 windows never straddle.
+            # no chunk; count them here (× the dedup multiplicity — the
+            # duplicates' severed windows are the same windows). n = 1
+            # windows never straddle.
             for n in spec.gram_lengths:
                 for s in range(p - n + 1, p):
                     key = (spec.gram_to_id(doc[s : s + n]), lang)
-                    corr[key] = corr.get(key, 0) + 1
+                    corr[key] = corr.get(key, 0) + m
         items.append(doc[prev:])
         item_langs.append(lang)
+        item_mult.append(m)
 
     langs_np = np.asarray(item_langs, dtype=np.int32)
+    mult_np = (
+        None if doc_mult is None
+        else np.asarray(item_mult, dtype=np.int32)
+    )
     order = np.argsort([len(d) for d in items], kind="stable")
     plan: list[tuple[np.ndarray, int]] = []
     if batch_rows is not None:
@@ -217,7 +251,7 @@ def plan_fit_batches(
             [(i, l, c) for (i, l), c in sorted(corr.items())], dtype=np.int64
         )
         straddle = (e[:, 0], e[:, 1], e[:, 2])
-    return items, langs_np, plan, straddle
+    return items, langs_np, plan, straddle, mult_np
 
 
 def iter_device_batches(
@@ -225,14 +259,18 @@ def iter_device_batches(
     item_langs: np.ndarray,
     plan,
     *,
+    item_mult: np.ndarray | None = None,
     placement=None,
     ragged: bool = True,
     ndata: int = 1,
     parent=None,
     depth: int = FIT_PIPELINE_DEPTH,
 ):
-    """Yield ``(batch, lengths, lang_ids, rows, pad_to)`` device operands for
-    every planned micro-batch, with packing and transfer pipelined ahead.
+    """Yield ``(batch, lengths, lang_ids, mult, rows, pad_to)`` device
+    operands for every planned micro-batch, with packing and transfer
+    pipelined ahead. ``mult`` is the per-row dedup multiplicity slice of
+    ``item_mult`` (None rides through when the plan carries no duplicates,
+    so duplicate-free fits dispatch the historical program unchanged).
 
     A background packer (the execution core's :func:`ordered_prefetch`
     pipeline, one worker so packs stay plan-ordered) walks ``plan`` in
@@ -276,10 +314,21 @@ def iter_device_batches(
         sel, pad_to = planned
         batch_docs = [items[k] for k in sel]
         blangs = item_langs[sel]
+        bmult = None if item_mult is None else item_mult[sel]
         if ndata > 1:
             from ..parallel.mesh import pad_rows_for_mesh
 
-            batch_docs, blangs = pad_rows_for_mesh(batch_docs, ndata, (blangs, 0))
+            if bmult is None:
+                batch_docs, blangs = pad_rows_for_mesh(
+                    batch_docs, ndata, (blangs, 0)
+                )
+            else:
+                # Pad rows are empty docs — zero windows either way — so
+                # their multiplicity value is inert; 1 keeps them shaped
+                # like real rows.
+                batch_docs, blangs, bmult = pad_rows_for_mesh(
+                    batch_docs, ndata, (blangs, 0), (bmult, 1)
+                )
         rows = len(batch_docs)
         real_bytes = sum(len(d) for d in batch_docs)
         use_ragged = False
@@ -315,8 +364,12 @@ def iter_device_batches(
         REGISTRY.incr("fit/real_bytes", real_bytes)
         REGISTRY.incr("fit/capacity_bytes", capacity)
         blangs = np.ascontiguousarray(blangs, dtype=np.int32)
+        if bmult is not None:
+            bmult = np.ascontiguousarray(bmult, dtype=np.int32)
         REGISTRY.incr(
-            "fit/wire_bytes", sum(a.nbytes for a in host) + blangs.nbytes
+            "fit/wire_bytes",
+            sum(a.nbytes for a in host) + blangs.nbytes
+            + (0 if bmult is None else bmult.nbytes),
         )
         if explicit_put:
             # Async puts: they return immediately and the copies overlap the
@@ -325,10 +378,14 @@ def iter_device_batches(
             with span("fit/put", parent=parent, rows=rows, pad_to=pad_to) as sp:
                 dev = tuple(jax.device_put(a, placement) for a in host)
                 blangs_dev = jax.device_put(blangs, placement)
+                bmult_dev = (
+                    None if bmult is None
+                    else jax.device_put(bmult, placement)
+                )
                 sp.fence(*dev)
         else:
-            dev, blangs_dev = host, blangs
-        return (use_ragged, dev, blangs_dev, rows, pad_to)
+            dev, blangs_dev, bmult_dev = host, blangs, bmult
+        return (use_ragged, dev, blangs_dev, bmult_dev, rows, pad_to)
 
     # The core's bounded ordered pipeline, one packer worker: packs (and
     # their async puts) stay in deterministic plan order, up to ``depth``
@@ -342,12 +399,12 @@ def iter_device_batches(
     )
     try:
         for _, packed, _, _ in pipeline:
-            use_ragged, dev, blangs_dev, rows, pad_to = packed()
+            use_ragged, dev, blangs_dev, bmult_dev, rows, pad_to = packed()
             if use_ragged:
                 flat, offs, lengths = dev
                 batch = unpack_ragged_jit(flat, offs, lengths, pad_to)
             else:
                 batch, lengths = dev
-            yield batch, lengths, blangs_dev, rows, pad_to
+            yield batch, lengths, blangs_dev, bmult_dev, rows, pad_to
     finally:
         pipeline.close()

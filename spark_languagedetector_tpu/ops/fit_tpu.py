@@ -30,6 +30,7 @@ def gram_counts_dense(
     batch: jnp.ndarray,
     lengths: jnp.ndarray,
     lang_ids: jnp.ndarray,
+    mult: jnp.ndarray | None = None,
     *,
     spec: VocabSpec,
     num_langs: int,
@@ -37,7 +38,12 @@ def gram_counts_dense(
     """Count windows per (gram id, language) for one padded batch.
 
     Args:
-      batch: uint8 [B, S]; lengths: int32 [B]; lang_ids: int32 [B].
+      batch: uint8 [B, S]; lengths: int32 [B]; lang_ids: int32 [B];
+      mult: optional int32 [B] per-row multiplicity — a deduplicated row
+        (docs/PERFORMANCE.md §10) counts exactly as many times as its
+        duplicates did, so dedup stays bit-preserving: integer window
+        counts scaled by an integer weight equal the duplicated sum.
+        ``None`` compiles the historical weightless program.
     Returns:
       int32 [V, L] occurrence counts (dense; V = spec.id_space_size).
     """
@@ -60,8 +66,10 @@ def gram_counts_dense(
         # Masked windows scatter a zero update into (0, lang) — harmless.
         rows = jnp.where(mask, ids, 0).reshape(-1)
         cols = jnp.broadcast_to(lang_ids[:, None], ids.shape).reshape(-1)
-        updates = mask.astype(jnp.int32).reshape(-1)
-        counts = counts.at[rows, cols].add(updates)
+        updates = mask.astype(jnp.int32)
+        if mult is not None:
+            updates = updates * mult.astype(jnp.int32)[:, None]
+        counts = counts.at[rows, cols].add(updates.reshape(-1))
     return counts
 
 
@@ -302,14 +310,17 @@ def fit_dense_step(
     lengths: jnp.ndarray,
     lang_ids: jnp.ndarray,
     counts_acc: jnp.ndarray,
+    mult: jnp.ndarray | None = None,
     *,
     spec: VocabSpec,
     num_langs: int,
 ) -> jnp.ndarray:
     """One accumulation step: counts_acc += counts(batch). Streaming fit over
-    micro-batches keeps HBM bounded regardless of corpus size."""
+    micro-batches keeps HBM bounded regardless of corpus size. ``mult`` is
+    the optional per-row dedup multiplicity (see :func:`gram_counts_dense`);
+    duplicate-free batches pass None and compile the historical program."""
     return counts_acc + gram_counts_dense(
-        batch, lengths, lang_ids, spec=spec, num_langs=num_langs
+        batch, lengths, lang_ids, mult, spec=spec, num_langs=num_langs
     )
 
 
@@ -386,8 +397,8 @@ def device_fit_context(
             mesh, spec, num_langs, shard_table=table_sharded
         )
 
-        def step(batch, lengths, lang_ids, acc, **_):
-            return sharded(batch, lengths, lang_ids, acc)
+        def step(batch, lengths, lang_ids, acc, mult=None, **_):
+            return sharded(batch, lengths, lang_ids, acc, mult=mult)
 
     elif jax.devices()[0].platform != "cpu":
         step = _fit_dense_step_donated
@@ -427,7 +438,7 @@ def accumulate_counts(
     )
 
     fixed_rows, byte_budget = resolve_fit_batching(batch_rows)
-    items, item_langs, plan, straddle = plan_fit_batches(
+    items, item_langs, plan, straddle, item_mult = plan_fit_batches(
         byte_docs, lang_arr, spec,
         batch_rows=fixed_rows, byte_budget=byte_budget,
     )
@@ -447,18 +458,18 @@ def accumulate_counts(
         # transfer applies on single-device dispatch only (a mesh shards
         # the padded batch itself — same rule as the scoring runner).
         batches = iter_device_batches(
-            items, item_langs, plan,
+            items, item_langs, plan, item_mult=item_mult,
             placement=ctx.placement, ragged=ctx.mesh is None, ndata=ctx.ndata,
             parent=count_span.parent,
         )
         try:
-            for batch, lengths, langs, rows, pad_to in batches:
+            for batch, lengths, langs, mult, rows, pad_to in batches:
                 faults.inject("fit/count")  # chaos: one call per count step
                 key = (rows, pad_to)
                 step_shapes[key] = step_shapes.get(key, 0) + 1
                 prev = counts
                 counts = ctx.step(
-                    batch, lengths, langs, counts,
+                    batch, lengths, langs, counts, mult=mult,
                     spec=spec, num_langs=num_langs,
                 )
                 if ctx.donate:

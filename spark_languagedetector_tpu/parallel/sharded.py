@@ -154,10 +154,32 @@ def make_sharded_fit_step(
             batch, lengths, lang_ids, counts_acc, spec=spec, num_langs=num_langs
         )
 
+    # Deduplicated batches carry a per-row multiplicity operand
+    # (docs/PERFORMANCE.md §10). jit compiles on first invocation, so a
+    # duplicate-free fit never builds this program and keeps the
+    # historical collective schedule byte for byte.
+    @partial(
+        jax.jit,
+        in_shardings=(
+            batch_sharding(mesh),
+            batch_sharding(mesh),
+            batch_sharding(mesh),
+            batch_sharding(mesh),
+            acc_sharding,
+        ),
+        out_shardings=acc_sharding,
+        donate_argnums=(4,) if donate else (),
+    )
+    def fit_step_mult(batch, lengths, lang_ids, mult, counts_acc):
+        return fit_tpu.fit_dense_step(
+            batch, lengths, lang_ids, counts_acc, mult,
+            spec=spec, num_langs=num_langs,
+        )
+
     ndata = int(mesh.shape[DATA_AXIS])
     steps = itertools.count()
 
-    def timed_step(batch, lengths, lang_ids, counts_acc):
+    def timed_step(batch, lengths, lang_ids, counts_acc, mult=None):
         # Chaos hook BEFORE the dispatch: an injected failure surfaces
         # before any collective is enqueued, so every process of a
         # multi-host mesh (running the same deterministic plan) fails the
@@ -170,7 +192,10 @@ def make_sharded_fit_step(
             rows_per_shard=batch.shape[0] // ndata,
             step=next(steps),
         ) as sp:
-            out = fit_step(batch, lengths, lang_ids, counts_acc)
+            if mult is None:
+                out = fit_step(batch, lengths, lang_ids, counts_acc)
+            else:
+                out = fit_step_mult(batch, lengths, lang_ids, mult, counts_acc)
             sp.fence(out)
         return out
 

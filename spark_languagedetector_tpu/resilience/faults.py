@@ -47,6 +47,12 @@ Sites (one hook per serving layer; docs/RESILIENCE.md §4):
     :class:`~..serve.batcher.ServeOverloaded`, exactly like a full
     queue), so chaos plans drive the load-shedding and hot-swap paths
     deterministically on CPU.
+  * ``serve/cache``    — every serve score-cache lookup and store
+    (:mod:`..serve.cache`): a firing ``error`` makes that one cache
+    operation fail, which the cache degrades to a miss (lookups
+    recompute, stores are skipped) — an injected cache fault can cost
+    throughput but can never produce a wrong or stale answer
+    (docs/SERVING.md §10).
   * ``fleet/probe``    — each router health-probe attempt against one
     replica (:meth:`serve.router.FleetRouter.probe_once`): a firing
     ``error`` reads as "replica unreachable", so probe-flap plans drive
@@ -83,6 +89,7 @@ SITES = (
     "fit/count",
     "shard_step",
     "serve/admit",
+    "serve/cache",
     "fleet/probe",
     "fleet/dispatch",
     "fleet/swap",

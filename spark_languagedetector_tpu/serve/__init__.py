@@ -35,6 +35,7 @@ from .batcher import (
     ServeOverloaded,
     ServeResult,
 )
+from .cache import ScoreCache
 from .registry import ModelRegistry, ModelVersion
 
 __all__ = [
@@ -44,6 +45,7 @@ __all__ = [
     "ContinuousBatcher",
     "ModelRegistry",
     "ModelVersion",
+    "ScoreCache",
     "ServeClosed",
     "ServeDeadlineExceeded",
     "ServeError",

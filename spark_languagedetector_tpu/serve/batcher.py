@@ -38,6 +38,7 @@ between dispatches and every request is answered by exactly one version.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from concurrent.futures import Future
@@ -48,11 +49,16 @@ import numpy as np
 
 from ..exec import config as exec_config
 from ..exec.core import AdmissionQueue
+from ..ops.encoding import UTF8
 from ..resilience import faults
 from ..telemetry import REGISTRY, current_trace_id, new_trace_id, span, trace_request
 from ..utils.logging import get_logger, log_event
 
 _log = get_logger("serve.batcher")
+
+# Process-unique tokens for _StaticSource cache scoping (in-process cache,
+# so a simple counter is sufficient identity).
+_STATIC_UIDS = itertools.count()
 
 # Priority lanes, drained in this order: a bulk backlog must never add
 # queueing delay to an interactive request.
@@ -143,13 +149,19 @@ class _StaticSource:
     the registry's lease protocol (version pinned to ``"v0"``)."""
 
     class _Entry:
-        __slots__ = ("runner", "version", "languages", "model")
+        __slots__ = ("runner", "version", "languages", "model", "uid")
 
         def __init__(self, runner):
             self.runner = runner
             self.version = "v0"
             self.languages = None
             self.model = None
+            # Cache-scope token: bare runners have no model uid, and every
+            # static source pins version "v0" — without a per-source token
+            # two batchers wrapping DIFFERENT runners but sharing one
+            # ScoreCache would collide on identical keys and serve one
+            # model's scores for the other.
+            self.uid = f"static_{next(_STATIC_UIDS)}"
 
     def __init__(self, runner):
         self._entry = self._Entry(runner)
@@ -182,11 +194,27 @@ class ContinuousBatcher:
         max_queue_rows: int | None = None,
         slo_ms: float | None = None,
         shed_bulk_when_degraded: bool = True,
+        cache=None,
+        cache_enable: bool | None = None,
         name: str = "serve",
     ):
         if not hasattr(source, "lease"):
             source = _StaticSource(source)
         self._source = source
+        # The version-keyed score cache (serve.cache, docs/SERVING.md §10):
+        # consulted per document under the dispatch's registry lease, so a
+        # hit is the bit-stored prior result of exactly the version this
+        # dispatch serves — hot-swaps invalidate structurally (new version
+        # ⇒ new keys). An explicit ``cache`` instance wins (shared across
+        # batchers); otherwise one is built when the ``cache_enable`` knob
+        # (env LANGDETECT_CACHE_ENABLE) resolves true.
+        if cache is None and bool(
+            exec_config.resolve("cache_enable", cache_enable)
+        ):
+            from .cache import ScoreCache
+
+            cache = ScoreCache()
+        self.cache = cache
         # Knob resolution through the audited config site: explicit ctor >
         # env > tuning profile (the autotuner's measured flush window) >
         # default. The batcher therefore loads the tuned profile at
@@ -422,6 +450,68 @@ class ContinuousBatcher:
             finally:
                 self._queue.done()
 
+    def _scored(self, entry, docs: list[bytes], want_labels: bool):
+        """One coalesced dispatch's results, through the score cache.
+
+        Per-document lookup under the held lease: hits are answered from
+        the leased version's stored results, misses ride the runner in
+        one call (whose in-flight dedup still collapses duplicate misses),
+        and every computed result is written back on fetch. Without a
+        cache this is exactly the direct runner call.
+        """
+        runner = entry.runner
+        cache = self.cache
+        if cache is None:
+            return (
+                runner.predict_ids(docs) if want_labels
+                else runner.score(docs)
+            )
+        mode = "labels" if want_labels else "scores"
+        encoding = getattr(runner, "score_encoding", UTF8)
+        # Key scope = model identity + version name. Version names alone
+        # repeat across independent sources (every registry auto-names
+        # "v1", "v2", ..., every static source pins "v0"), so a cache
+        # shared across batchers needs the model uid (persisted with the
+        # model — replicas loading one path share entries) or the static
+        # source's per-instance token in the key to make "never a wrong
+        # answer" structural rather than conventional.
+        scope = getattr(getattr(entry, "model", None), "uid", None) or (
+            getattr(entry, "uid", None)
+        )
+        version = f"{scope}:{entry.version}" if scope else entry.version
+        cached = cache.get_many(version, mode, encoding, docs)
+        miss = [i for i, c in enumerate(cached) if c is None]
+        if miss:
+            miss_docs = [docs[i] for i in miss]
+            miss_out = (
+                runner.predict_ids(miss_docs) if want_labels
+                else runner.score(miss_docs)
+            )
+        if len(miss) == len(docs):
+            out = miss_out
+        else:
+            # L from the results themselves (never runner internals —
+            # registry sources may wrap test doubles): any cached value
+            # is an [L] row, any miss result a [rows, L] block.
+            if want_labels:
+                out = np.empty(len(docs), np.int32)
+            else:
+                L = (
+                    np.asarray(miss_out).shape[1] if miss
+                    else np.asarray(
+                        next(c for c in cached if c is not None)
+                    ).shape[0]
+                )
+                out = np.empty((len(docs), L), np.float32)
+            for i, c in enumerate(cached):
+                if c is not None:
+                    out[i] = c
+            for j, i in enumerate(miss):
+                out[i] = miss_out[j]
+        if miss:
+            cache.put_many(version, mode, encoding, miss_docs, list(miss_out))
+        return out
+
     def _dispatch(self, batch: list[_Request]) -> None:
         t_start = time.monotonic()
         live: list[_Request] = []
@@ -461,10 +551,7 @@ class ContinuousBatcher:
                     version=entry.version, labels=want_labels,
                 ):
                     t0 = time.perf_counter()
-                    if want_labels:
-                        out = entry.runner.predict_ids(docs)
-                    else:
-                        out = entry.runner.score(docs)
+                    out = self._scored(entry, docs, want_labels)
                     dispatch_s = time.perf_counter() - t0
         except Exception as e:
             REGISTRY.incr("serve/dispatch_errors")

@@ -1,0 +1,253 @@
+"""Version-keyed content-addressed score cache: level 2 of the redundancy
+eliminator (docs/PERFORMANCE.md §10).
+
+The in-flight dedup (level 1, ``exec.core.dedup_items``) eliminates
+duplicate rows *within* one dispatch; this module eliminates them *across*
+dispatches and requests: a bounded, sharded LRU in front of the serving
+runner, keyed by ``(model version, result mode, score encoding, document
+bytes)``. The batcher consults it per document under the registry lease it
+already holds, so every answer — cached or computed — comes from exactly
+the leased version:
+
+  * **Parity** — a hit returns the bit-stored prior result of the *same*
+    version, so per-version parity is exact by construction. (A
+    *recomputed* duplicate under a matmul strategy may differ from the
+    stored bits in the last f32 ulp across batch geometries — the
+    reduction-order class in docs/ARCHITECTURE.md; gather/fused runners
+    are bit-exact either way.)
+  * **Staleness** — impossible structurally, not by invalidation
+    callbacks: the version in the key is the leased entry's, and a
+    hot-swap (single registry or the fleet's two-phase flip) moves the
+    pointer *between* leases. A post-swap dispatch leases the new version
+    and therefore can only read/write the new version's keys; every
+    pre-swap entry is unreachable from it by construction and ages out of
+    the LRU (docs/SERVING.md §10).
+  * **Keys are the bytes themselves** — dict hashing + equality, so a
+    "collision" is a true content match; there is no digest to get wrong.
+
+Bounded by entries (``LANGDETECT_CACHE_ROWS``) and bytes
+(``LANGDETECT_CACHE_BYTES`` — keys plus stored results), both resolved
+through ``exec.config`` (a tuning profile may carry measured sizes —
+``exec.tune`` solves them from a capture's observed duplicate mass).
+Sharded to keep lock hold times tiny under concurrent front-end threads.
+
+Chaos: every lookup/store passes the ``serve/cache`` fault site. An
+injected failure degrades that operation to a miss (or skips the store) —
+never a wrong answer, pinned by ``tests/test_cache.py``.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from ..exec import config as exec_config
+from ..resilience import faults
+from ..telemetry import REGISTRY
+from ..utils.logging import get_logger, log_event
+
+_log = get_logger("serve.cache")
+
+# Fixed per-entry accounting overhead (key tuple, OrderedDict node, numpy
+# header) so a cache of tiny documents can't balloon unaccounted.
+ENTRY_OVERHEAD_BYTES = 128
+
+
+class ScoreCache:
+    """Bounded, sharded, version-keyed LRU over per-document score results.
+
+    ``get``/``put`` take the leased version plus the result mode
+    (``"labels"`` / ``"scores"``), the runner's ``score_encoding``, and the
+    raw document bytes; values are per-document numpy results (a ``[L]``
+    float32 score row, or a 0-d int32 argmax id). Thread-safe; eviction is
+    LRU per shard under the global row/byte bounds split evenly across
+    shards.
+    """
+
+    def __init__(
+        self,
+        *,
+        max_rows: int | None = None,
+        max_bytes: int | None = None,
+        shards: int = 8,
+    ):
+        self.max_rows = int(exec_config.resolve("cache_rows", max_rows))
+        self.max_bytes = int(exec_config.resolve("cache_bytes", max_bytes))
+        if self.max_rows < 1 or self.max_bytes < 1:
+            raise ValueError("cache_rows and cache_bytes must be >= 1")
+        n = max(1, int(shards))
+        self._shards: list[OrderedDict] = [OrderedDict() for _ in range(n)]
+        self._locks = [threading.Lock() for _ in range(n)]
+        # Per-shard byte tallies; rows are len(shard). Shard bounds split
+        # the global budget evenly (the content hash spreads keys).
+        self._bytes = [0] * n
+        self._shard_rows = max(1, self.max_rows // n)
+        self._shard_bytes = max(1, self.max_bytes // n)
+        # Lifetime tallies for stats() (/varz), per shard so every update
+        # happens under the lock it already holds; the REGISTRY counters
+        # are process-global and shared with any other cache instance.
+        self._hits = [0] * n
+        self._misses = [0] * n
+        self._evictions = [0] * n
+        log_event(
+            _log, "serve.cache.start", max_rows=self.max_rows,
+            max_bytes=self.max_bytes, shards=n,
+        )
+
+    # ------------------------------------------------------------ internals --
+    def _shard_of(self, key) -> int:
+        return hash(key) % len(self._shards)
+
+    def _gauges(self) -> None:
+        REGISTRY.set_gauge("langdetect_cache_rows", float(self.rows))
+        REGISTRY.set_gauge("langdetect_cache_bytes", float(self.bytes))
+
+    @property
+    def rows(self) -> int:
+        return sum(len(s) for s in self._shards)
+
+    @property
+    def bytes(self) -> int:
+        return sum(self._bytes)
+
+    # ------------------------------------------------------------- lookup ---
+    def get(self, version: str, mode: str, encoding: str, doc: bytes):
+        """The cached result for ``doc`` under ``version``, or None.
+
+        A hit refreshes LRU order and is counted (``cache/hits``,
+        ``cache/bytes_saved`` — the document bytes that now skip the
+        wire). An injected ``serve/cache`` fault reads as a miss: the
+        caller recomputes, losing only the saving.
+        """
+        return self.get_many(version, mode, encoding, (doc,))[0]
+
+    def get_many(
+        self, version: str, mode: str, encoding: str, docs
+    ) -> list:
+        """Batched :meth:`get` — one REGISTRY update per counter per call
+        instead of per document, which is what keeps the serve dispatch
+        loop off the global metrics lock at hundreds of rows per
+        coalesce. Fault injection stays per document (the ``serve/cache``
+        replay schedule is call-for-call identical to a loop of ``get``);
+        per-doc LRU refresh and shard stats are unchanged.
+        """
+        out = []
+        hits = misses = faulted = saved = 0
+        for doc in docs:
+            try:
+                faults.inject("serve/cache")
+            except faults.InjectedFault:
+                faulted += 1
+                misses += 1
+                with self._locks[0]:
+                    self._misses[0] += 1
+                out.append(None)
+                continue
+            key = (version, mode, encoding, doc)
+            i = self._shard_of(key)
+            with self._locks[i]:
+                shard = self._shards[i]
+                hit = shard.get(key)
+                if hit is None:
+                    self._misses[i] += 1
+                else:
+                    shard.move_to_end(key)
+                    self._hits[i] += 1
+            if hit is None:
+                misses += 1
+                out.append(None)
+            else:
+                hits += 1
+                saved += len(doc)
+                out.append(hit[0])
+        if faulted:
+            REGISTRY.incr("cache/faults", faulted)
+        if out:
+            REGISTRY.incr("cache/lookups", len(out))
+        if misses:
+            REGISTRY.incr("cache/misses", misses)
+        if hits:
+            REGISTRY.incr("cache/hits", hits)
+            REGISTRY.incr("cache/bytes_saved", saved)
+        return out
+
+    # -------------------------------------------------------------- store ---
+    def put(
+        self, version: str, mode: str, encoding: str, doc: bytes, value
+    ) -> None:
+        """Store one document's result (written on fetch, after a dispatch
+        settles). Oversized single entries are refused rather than
+        flushing a whole shard; injected faults skip the store."""
+        self.put_many(version, mode, encoding, (doc,), (value,))
+
+    def put_many(
+        self, version: str, mode: str, encoding: str, docs, values
+    ) -> None:
+        """Batched :meth:`put`: the eviction counter and the occupancy
+        gauges (an O(shards) sum each) update once per call rather than
+        per stored document. Fault injection stays per document — the
+        ``serve/cache`` replay schedule is call-for-call identical to a
+        loop of ``put``."""
+        evicted = 0
+        for doc, value in zip(docs, values):
+            try:
+                faults.inject("serve/cache")
+            except faults.InjectedFault:
+                REGISTRY.incr("cache/faults")
+                continue
+            # Copy: callers hand in views of the dispatch's result array,
+            # and a stored view would pin the whole [B, L] base buffer in
+            # memory. Read-only: get() hands back the stored array itself,
+            # so an in-place edit by a caller would otherwise corrupt
+            # every future hit.
+            value = np.array(value)
+            value.setflags(write=False)
+            cost = len(doc) + int(value.nbytes) + ENTRY_OVERHEAD_BYTES
+            if cost > self._shard_bytes:
+                continue
+            key = (version, mode, encoding, doc)
+            i = self._shard_of(key)
+            with self._locks[i]:
+                shard = self._shards[i]
+                old = shard.pop(key, None)
+                if old is not None:
+                    self._bytes[i] -= old[1]
+                shard[key] = (value, cost)
+                self._bytes[i] += cost
+                dropped = 0
+                while len(shard) > self._shard_rows or (
+                    self._bytes[i] > self._shard_bytes and shard
+                ):
+                    _, (_, old_cost) = shard.popitem(last=False)
+                    self._bytes[i] -= old_cost
+                    dropped += 1
+                self._evictions[i] += dropped
+            evicted += dropped
+        if evicted:
+            REGISTRY.incr("cache/evictions", evicted)
+        self._gauges()
+
+    # -------------------------------------------------------------- admin ---
+    def clear(self) -> None:
+        for i, lock in enumerate(self._locks):
+            with lock:
+                self._shards[i].clear()
+                self._bytes[i] = 0
+        self._gauges()
+
+    def stats(self) -> dict:
+        """Point-in-time snapshot for /varz and healthz."""
+        hits, misses = sum(self._hits), sum(self._misses)
+        lookups = hits + misses
+        return {
+            "rows": self.rows,
+            "bytes": self.bytes,
+            "max_rows": self.max_rows,
+            "max_bytes": self.max_bytes,
+            "hits": hits,
+            "misses": misses,
+            "evictions": sum(self._evictions),
+            "hit_rate": round(hits / lookups, 6) if lookups else 0.0,
+        }

@@ -404,6 +404,10 @@ class ServingServer(JsonHTTPFront):
             "draining": self._draining,
             "uptime_s": round(time.monotonic() - self._started, 3),
             "batcher": self.batcher.stats(),
+            "cache": (
+                None if self.batcher.cache is None
+                else self.batcher.cache.stats()
+            ),
         }
         try:
             entry = self.registry.peek()
@@ -431,6 +435,12 @@ class ServingServer(JsonHTTPFront):
             "versions": (
                 self.registry.versions()
                 if hasattr(self.registry, "versions") else []
+            ),
+            # Hit rate + occupancy of the serve score cache (None when
+            # disabled) — the level-2 half of docs/PERFORMANCE.md §10.
+            "cache": (
+                None if self.batcher.cache is None
+                else self.batcher.cache.stats()
             ),
             # The audited effective config: every LANGDETECT_* knob's live
             # value and provenance (explicit/env/profile/default), plus

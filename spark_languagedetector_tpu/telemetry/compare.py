@@ -42,7 +42,10 @@ DEFAULT_METRICS = ("p50", "p90", "p99", "device_total_s", "device_p99")
 # ``serve/fill_ratio`` regresses when it *drops* (emptier dispatches) and
 # ``serve/padding_waste`` when it *rises* — the two sides of the padding
 # tax docs/PERFORMANCE.md §9 describes, pinned by tests/test_exec.py.
-_HIGHER_BETTER = ("fill_ratio",)
+# ``cache/hit_rate`` joins it for the redundancy-elimination contract
+# (docs/PERFORMANCE.md §10): on the same replayed workload, a candidate
+# whose serve cache stops hitting has regressed downward.
+_HIGHER_BETTER = ("fill_ratio", "hit_rate")
 
 # Tracked gauges (last snapshot): byte-traffic contract metrics, keyed to
 # a short stable name. A change that silently de-quantizes a profile
@@ -70,6 +73,16 @@ _TRACKED_RATIOS = {
     "fill_ratio[serve/coalesce]": (
         "serve/coalesced_rows", "serve/dispatch_capacity_rows"
     ),
+    # Redundancy-elimination contract metrics (docs/PERFORMANCE.md §10),
+    # exact whole-run ratios from the dedup/cache counters. On a fixed
+    # replayed workload: ``cache/hit_rate`` regresses DOWNWARD (substring
+    # match in _HIGHER_BETTER — fewer hits on the same traffic means the
+    # cache layer broke), while ``dedup/unique_ratio`` (rows the wire
+    # still carries / rows submitted) regresses UPWARD like any other
+    # cost ratio — a dedup layer that stops collapsing the same
+    # duplicates drifts toward 1.0.
+    "cache/hit_rate": ("cache/hits", "cache/lookups"),
+    "dedup/unique_ratio": ("dedup/rows_unique", "dedup/rows_in"),
 }
 
 

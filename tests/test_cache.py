@@ -1,0 +1,732 @@
+"""Content-addressed scoring cache + in-flight dedup (ISSUE 10).
+
+The acceptance contract: duplicate documents ride the wire and the kernel
+once — the runner's in-flight dedup scatters unique results back to input
+order bit-exactly on geometry-stable strategies (label-exact within the
+reduction-order tolerance class on matmul strategies) — and the serve
+cache answers repeats from the bit-stored prior result of exactly the
+leased version, so a hot-swap can never serve a stale answer (new version
+⇒ new keys, structurally). Injected ``serve/cache`` faults degrade to
+miss-and-recompute, never to a wrong answer, and replay deterministically.
+Labels-only requests fetch ids, never the ``[B, L]`` score matrix
+(``score/fetch_bytes`` pins the d2h contract on every strategy and
+degraded-ladder rung).
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+from spark_languagedetector_tpu import Table
+from spark_languagedetector_tpu.api.runner import BatchRunner, resolve_mesh
+from spark_languagedetector_tpu.exec import config as exec_config
+from spark_languagedetector_tpu.exec.core import dedup_items
+from spark_languagedetector_tpu.ops.encoding import texts_to_bytes
+from spark_languagedetector_tpu.ops.vocab import EXACT, VocabSpec
+from spark_languagedetector_tpu.resilience import faults
+from spark_languagedetector_tpu.resilience.faults import FaultPlan
+from spark_languagedetector_tpu.serve import ContinuousBatcher, ModelRegistry
+from spark_languagedetector_tpu.serve.cache import ScoreCache
+from spark_languagedetector_tpu.telemetry import REGISTRY
+
+SPEC12 = VocabSpec(EXACT, (1, 2))
+L = 5  # languages: keeps the ids-vs-scores fetch contrast unmistakable
+LANGS = tuple(f"l{i}" for i in range(L))
+
+
+@functools.lru_cache(maxsize=None)
+def _runner(strategy="gather", seed=0):
+    """Random dense-table runner — no fit, compiles once per (strategy,
+    seed) thanks to the cache (jit programs compile per runner instance)."""
+    rng = np.random.default_rng(seed)
+    weights = rng.normal(size=(SPEC12.id_space_size, L)).astype(np.float32)
+    return BatchRunner(
+        weights=np.asarray(weights), lut=None, spec=SPEC12,
+        strategy=strategy, batch_size=64,
+    )
+
+
+# Shared with tests/test_fleet.py (same lru cache): every distinct model
+# instance costs a ~3s runner compile, and this module runs first in
+# alphabetical order, so using the fleet suite's seeds means the compiles
+# are paid once for both modules. Different seeds fit different weights,
+# which is what makes a stale cached answer detectable as a bit mismatch.
+from tests.test_fleet import _model  # noqa: E402
+
+
+def _docs_with_dups(rng, n=64, dup_frac=0.6):
+    pool = [
+        bytes(rng.integers(97, 105, int(rng.integers(0, 40)), dtype=np.uint8))
+        for _ in range(max(2, int(n * (1 - dup_frac))))
+    ]
+    return [pool[int(i)] for i in rng.integers(0, len(pool), n)]
+
+
+def _counter(name):
+    return int(REGISTRY.snapshot()["counters"].get(name, 0))
+
+
+# ------------------------------------------------------------ dedup core ----
+def test_dedup_items_mapping_and_mult():
+    keys = [b"a", b"b", b"a", b"", b"b", b"a", b""]
+    first, inverse, mult = dedup_items(keys)
+    assert first.tolist() == [0, 1, 3]
+    assert [keys[i] for i in first] == [b"a", b"b", b""]
+    assert mult.tolist() == [3, 2, 2]
+    rebuilt = [keys[first[j]] for j in inverse]
+    assert rebuilt == keys
+
+
+def test_dedup_items_all_unique_returns_none():
+    assert dedup_items([b"a", b"b", b"c"]) is None
+    assert dedup_items([]) is None
+    # Tuple keys (the fit's (doc, lang) form): same doc, different lang
+    # stays distinct.
+    assert dedup_items([(b"a", 0), (b"a", 1)]) is None
+    assert dedup_items([(b"a", 0), (b"a", 0)]) is not None
+
+
+# --------------------------------------------------------- runner dedup -----
+def test_runner_dedup_bit_exact_on_gather_fuzz():
+    runner = _runner("gather")
+    rng = np.random.default_rng(42)
+    try:
+        for trial in range(4):
+            docs = _docs_with_dups(rng)
+            if trial == 3:
+                # Chunked long docs, duplicated: scatter-back must compose
+                # with the cross-chunk score summation.
+                big = bytes(rng.integers(97, 105, 9000, dtype=np.uint8))
+                docs += [big, big]
+            runner.dedup = True
+            s_on = runner.score(docs)
+            ids_on = runner.predict_ids(docs)
+            runner.dedup = False
+            s_off = runner.score(docs)
+            ids_off = runner.predict_ids(docs)
+            np.testing.assert_array_equal(s_on, s_off)
+            np.testing.assert_array_equal(ids_on, ids_off)
+    finally:
+        runner.dedup = True
+
+
+def test_runner_dedup_label_exact_on_matmul():
+    """onehot rides the MXU matmul: the deduped call's batch geometry may
+    differ, so scores carry the reduction-order tolerance class — labels
+    must still be exact against argmax-of-scores."""
+    runner = _runner("onehot")
+    rng = np.random.default_rng(7)
+    docs = _docs_with_dups(rng, n=48)
+    try:
+        runner.dedup = True
+        s_on = runner.score(docs)
+        ids_on = runner.predict_ids(docs)
+        runner.dedup = False
+        s_off = runner.score(docs)
+    finally:
+        runner.dedup = True
+    np.testing.assert_allclose(s_on, s_off, rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(ids_on, np.argmax(s_off, axis=1))
+
+
+def test_runner_dedup_identical_rows_share_result():
+    """Every duplicate reads the unique row's stored bits — the scattered
+    rows are identical, not merely close."""
+    runner = _runner("gather")
+    docs = [b"abab", b"zzq", b"abab", b"abab", b"zzq"]
+    scores = runner.score(docs)
+    np.testing.assert_array_equal(scores[0], scores[2])
+    np.testing.assert_array_equal(scores[0], scores[3])
+    np.testing.assert_array_equal(scores[1], scores[4])
+
+
+def test_runner_dedup_counters_and_knob(monkeypatch):
+    runner = _runner("gather")
+    docs = [b"dup", b"dup", b"dup", b"solo"]
+    before_in, before_uniq = _counter("dedup/rows_in"), _counter(
+        "dedup/rows_unique"
+    )
+    runner.score(docs)
+    assert _counter("dedup/rows_in") - before_in == 4
+    assert _counter("dedup/rows_unique") - before_uniq == 2
+    # The env knob resolves at construction: LANGDETECT_DEDUP=0 builds
+    # runners with the eliminator off.
+    monkeypatch.setenv("LANGDETECT_DEDUP", "0")
+    off = BatchRunner(
+        weights=np.zeros((SPEC12.id_space_size, 2), np.float32), lut=None,
+        spec=SPEC12,
+    )
+    assert off.dedup is False
+    monkeypatch.setenv("LANGDETECT_DEDUP", "junk")
+    with pytest.raises(ValueError):
+        BatchRunner(
+            weights=np.zeros((SPEC12.id_space_size, 2), np.float32),
+            lut=None, spec=SPEC12,
+        )
+
+
+def test_runner_dedup_empty_and_zero_docs():
+    runner = _runner("gather")
+    assert runner.score([]).shape == (0, L)
+    docs = [b"", b"", b"x"]
+    runner.dedup = True
+    s_on = runner.score(docs)
+    runner.dedup = False
+    s_off = runner.score(docs)
+    runner.dedup = True
+    np.testing.assert_array_equal(s_on, s_off)
+    np.testing.assert_array_equal(s_on[0], s_on[1])
+
+
+# ------------------------------------------------------------- d2h audit ----
+def test_labels_fetch_ids_not_score_matrix():
+    runner = _runner("gather", seed=3)
+    rng = np.random.default_rng(9)
+    docs = list({bytes(rng.integers(97, 105, 30, dtype=np.uint8)): None
+                 for _ in range(64)})  # all unique: N == fetched rows
+    n = len(docs)
+    before = _counter("score/fetch_bytes")
+    runner.predict_ids(docs)
+    ids_bytes = _counter("score/fetch_bytes") - before
+    before = _counter("score/fetch_bytes")
+    runner.score(docs)
+    score_bytes = _counter("score/fetch_bytes") - before
+    assert ids_bytes == 4 * n
+    assert score_bytes == 4 * n * L
+    assert ids_bytes * L <= score_bytes
+
+
+def test_labels_fetch_chunked_docs_fetch_only_their_rows():
+    runner = _runner("gather", seed=3)
+    big = bytes(np.random.default_rng(1).integers(97, 105, 9000, dtype=np.uint8))
+    docs = [b"short one", big, b"another short"]
+    before = _counter("score/fetch_bytes")
+    runner.predict_ids(docs)
+    delta = _counter("score/fetch_bytes") - before
+    # 4 bytes per scored row (chunks included) + one full [chunks, L] score
+    # row set for the single chunked doc — nowhere near all rows × L.
+    chunks = 2 + -(-len(big) // runner.max_chunk) + 1
+    assert delta <= 4 * chunks + 4 * L * chunks
+    assert delta < 4 * L * 64
+
+
+def test_labels_fetch_ids_on_mesh(eight_devices):
+    runner = BatchRunner(
+        weights=np.random.default_rng(2).normal(
+            size=(SPEC12.id_space_size, L)
+        ).astype(np.float32),
+        lut=None, spec=SPEC12, mesh=resolve_mesh("mesh"), batch_size=64,
+    )
+    docs = [f"doc number {i}".encode() for i in range(40)]
+    before = _counter("score/fetch_bytes")
+    ids = runner.predict_ids(docs)
+    delta = _counter("score/fetch_bytes") - before
+    assert ids.shape == (40,)
+    # Mesh pad rows may fetch a few extra ids, never the score matrix.
+    assert delta <= 4 * (40 + 8)
+    single = _runner("gather", seed=2)
+    np.testing.assert_array_equal(ids, np.argmax(runner.score(docs), axis=1))
+    del single
+
+
+def test_labels_fetch_ids_on_degraded_ladder():
+    """The ladder rungs honor the d2h contract too: a batch that falls to
+    the host rung still fetches [B] ids in label mode."""
+    runner = _runner("gather", seed=5)
+    docs = [b"degraded fetch probe %d" % i for i in range(16)]
+    want = runner.predict_ids(docs)
+    before_deg = _counter("resilience/degraded_batches")
+    plan = FaultPlan.parse("score/dispatch:error@1-2")  # attempt + replay
+    with faults.plan_scope(plan):
+        before = _counter("score/fetch_bytes")
+        got = runner.predict_ids(docs)
+        delta = _counter("score/fetch_bytes") - before
+    np.testing.assert_array_equal(got, want)
+    assert _counter("resilience/degraded_batches") == before_deg + 1
+    assert delta == 4 * len(docs)
+    runner.breaker.record_success()
+    runner._degraded_mode = False
+
+
+# ------------------------------------------------------------ score cache ---
+def test_score_cache_roundtrip_and_version_keying():
+    cache = ScoreCache(max_rows=64, max_bytes=1 << 20)
+    row = np.arange(L, dtype=np.float32)
+    cache.put("v1", "scores", "utf-8", b"doc", row)
+    got = cache.get("v1", "scores", "utf-8", b"doc")
+    np.testing.assert_array_equal(got, row)
+    # Stored bits are decoupled from the caller's buffer.
+    row[0] = 99.0
+    np.testing.assert_array_equal(
+        cache.get("v1", "scores", "utf-8", b"doc"),
+        np.asarray([0, 1, 2, 3, 4], np.float32),
+    )
+    # A different version / mode / encoding is a different key space.
+    assert cache.get("v2", "scores", "utf-8", b"doc") is None
+    assert cache.get("v1", "labels", "utf-8", b"doc") is None
+    assert cache.get("v1", "scores", "low_byte", b"doc") is None
+    stats = cache.stats()
+    assert stats["hits"] == 2 and stats["misses"] == 3
+    assert stats["rows"] == 1 and stats["bytes"] > 0
+
+
+def test_score_cache_lru_eviction_by_rows_and_bytes():
+    cache = ScoreCache(max_rows=8, max_bytes=1 << 20, shards=1)
+    for i in range(12):
+        cache.put("v1", "labels", "utf-8", b"d%d" % i, np.int32(i))
+    assert cache.rows == 8
+    assert cache.get("v1", "labels", "utf-8", b"d0") is None  # evicted
+    assert int(cache.get("v1", "labels", "utf-8", b"d11")) == 11
+    assert cache.stats()["evictions"] == 4
+    # Byte bound: large values evict down to fit.
+    small = ScoreCache(max_rows=1000, max_bytes=4096, shards=1)
+    for i in range(8):
+        small.put(
+            "v1", "scores", "utf-8", b"k%d" % i,
+            np.zeros(128, np.float32),  # 512B + overhead each
+        )
+    assert small.bytes <= 4096
+    assert small.rows < 8
+    # An entry larger than a whole shard is refused, not cycled through.
+    small.put("v1", "scores", "utf-8", b"huge", np.zeros(4096, np.float32))
+    assert small.get("v1", "scores", "utf-8", b"huge") is None
+
+
+def test_score_cache_gauges_track_occupancy():
+    cache = ScoreCache(max_rows=16, max_bytes=1 << 20)
+    cache.put("v1", "labels", "utf-8", b"g", np.int32(1))
+    gauges = REGISTRY.snapshot()["gauges"]
+    assert any(
+        k == "langdetect_cache_rows" and any(
+            v >= 1 for v in series.values()
+        )
+        for k, series in gauges.items()
+        if isinstance(series, dict)
+    )
+
+
+# -------------------------------------------------------- batcher + cache ---
+def test_batcher_cache_answers_repeat_without_rescoring():
+    runner = _runner("gather")
+    with ContinuousBatcher(runner, max_wait_ms=2, max_rows=64) as b:
+        docs = texts_to_bytes(["abab", "zz", "abczz"])
+        first = b.submit(docs).result()
+        scored_after_first = runner.metrics.snapshot().get("docs_scored", 0)
+        second = b.submit(docs).result()
+        scored_after_second = runner.metrics.snapshot().get("docs_scored", 0)
+        np.testing.assert_array_equal(first.values, second.values)
+        np.testing.assert_array_equal(
+            first.values, runner.score(docs)
+        )
+        assert scored_after_second == scored_after_first  # pure cache hits
+        assert b.cache.stats()["hits"] >= len(docs)
+
+
+def test_batcher_concurrent_requests_dedup_in_one_dispatch():
+    """Two concurrent requests with the same documents coalesce into one
+    dispatch whose runner call sees the duplicate rows ONCE (level-1 dedup
+    across requests), and both callers get the same bits."""
+    runner = _runner("gather")
+    docs = texts_to_bytes(["abab", "zzzz"])
+    with ContinuousBatcher(
+        runner, max_wait_ms=60, max_rows=256, cache_enable=False
+    ) as b:
+        before_in = _counter("dedup/rows_in")
+        before_uniq = _counter("dedup/rows_unique")
+        f1 = b.submit(docs)
+        f2 = b.submit(docs)
+        r1, r2 = f1.result(timeout=30), f2.result(timeout=30)
+    np.testing.assert_array_equal(r1.values, r2.values)
+    assert _counter("dedup/rows_in") - before_in == 4
+    assert _counter("dedup/rows_unique") - before_uniq == 2
+    assert _counter("serve/dispatches") >= 1
+
+
+def test_swap_under_cached_traffic_never_serves_stale():
+    """The structural-invalidation contract: after a hot-swap, the same
+    documents must be answered by the NEW version's runner — bit-equal to
+    it, and not to the old version's cached rows."""
+    m1, m2 = _model(1), _model(2)
+    registry = ModelRegistry()
+    registry.install(m1, version="v1")
+    docs = texts_to_bytes(["abab", "abczz", "zz"])
+    with ContinuousBatcher(registry, max_wait_ms=2, max_rows=64) as b:
+        r1 = b.submit(docs).result()
+        r1b = b.submit(docs).result()  # warm: answered from cache
+        assert r1b.version == "v1"
+        registry.install(m2, version="v2")
+        r2 = b.submit(docs).result()
+    assert r1.version == "v1" and r2.version == "v2"
+    np.testing.assert_array_equal(r1.values, m1._get_runner().score(docs))
+    np.testing.assert_array_equal(r2.values, m2._get_runner().score(docs))
+    assert not np.array_equal(r2.values, r1.values)
+
+
+def test_shared_cache_does_not_leak_across_models():
+    """One ScoreCache shared by batchers over DIFFERENT models: version
+    names alone collide (every static source pins "v0", every registry
+    auto-names "v1", ...), so the batcher scopes keys by model uid /
+    static-source token — each model must be answered from its own
+    entries, never the other's."""
+    m1, m2 = _model(1), _model(2)
+    r1, r2 = m1._get_runner(), m2._get_runner()
+    docs = texts_to_bytes(["abab", "zz"])
+    shared = ScoreCache(max_rows=64, max_bytes=1 << 20)
+    with ContinuousBatcher(r1, max_wait_ms=2, max_rows=64, cache=shared) as b1:
+        with ContinuousBatcher(
+            r2, max_wait_ms=2, max_rows=64, cache=shared
+        ) as b2:
+            a1 = b1.submit(docs).result()
+            a2 = b2.submit(docs).result()  # same "v0" version name
+            a1b = b1.submit(docs).result()  # warm repeat stays per-model
+    np.testing.assert_array_equal(a1.values, r1.score(docs))
+    np.testing.assert_array_equal(a2.values, r2.score(docs))
+    np.testing.assert_array_equal(a1b.values, a1.values)
+    assert not np.array_equal(a1.values, a2.values)
+    # Registry-backed sources: two independent registries both auto-name
+    # "v1" — the model uid in the key keeps them apart too.
+    reg1, reg2 = ModelRegistry(), ModelRegistry()
+    assert reg1.install(m1) == reg2.install(m2) == "v1"
+    shared2 = ScoreCache(max_rows=64, max_bytes=1 << 20)
+    with ContinuousBatcher(
+        reg1, max_wait_ms=2, max_rows=64, cache=shared2
+    ) as b1:
+        with ContinuousBatcher(
+            reg2, max_wait_ms=2, max_rows=64, cache=shared2
+        ) as b2:
+            a1 = b1.submit(docs).result()
+            a2 = b2.submit(docs).result()
+    np.testing.assert_array_equal(a1.values, r1.score(docs))
+    np.testing.assert_array_equal(a2.values, r2.score(docs))
+
+
+def test_get_many_put_many_match_per_doc_calls():
+    """The batched entry points (what the dispatch loop uses) must be
+    observationally identical to a loop of get/put — counters included."""
+    c = ScoreCache(max_rows=64, max_bytes=1 << 20)
+    docs = [b"a", b"b", b"a", b"c"]
+    vals = [np.int32(i) for i in range(4)]
+    before = {k: _counter(f"cache/{k}") for k in ("lookups", "hits", "misses")}
+    assert c.get_many("v1", "labels", "utf-8", docs) == [None] * 4
+    c.put_many("v1", "labels", "utf-8", docs, vals)
+    got = c.get_many("v1", "labels", "utf-8", docs)
+    # b"a" stored twice: last write wins, both positions see it.
+    assert [int(g) for g in got] == [2, 1, 2, 3]
+    assert _counter("cache/lookups") - before["lookups"] == 8
+    assert _counter("cache/misses") - before["misses"] == 4
+    assert _counter("cache/hits") - before["hits"] == 4
+    assert c.stats()["hits"] == 4 and c.stats()["misses"] == 4
+
+
+def test_cache_disabled_by_knob(monkeypatch):
+    monkeypatch.setenv("LANGDETECT_CACHE_ENABLE", "0")
+    runner = _runner("gather")
+    with ContinuousBatcher(runner, max_wait_ms=2) as b:
+        assert b.cache is None
+        docs = texts_to_bytes(["abab"])
+        out = b.submit(docs).result()
+        np.testing.assert_array_equal(out.values, runner.score(docs))
+
+
+def test_cache_knob_resolution(monkeypatch):
+    monkeypatch.setenv("LANGDETECT_CACHE_ROWS", "128")
+    monkeypatch.setenv("LANGDETECT_CACHE_BYTES", str(1 << 16))
+    cache = ScoreCache()
+    assert cache.max_rows == 128 and cache.max_bytes == 1 << 16
+    monkeypatch.setenv("LANGDETECT_CACHE_ROWS", "-1")
+    with pytest.raises(ValueError):
+        ScoreCache()
+
+
+# ------------------------------------------------------- chaos: serve/cache -
+def test_injected_cache_faults_degrade_to_miss_and_recompute():
+    runner = _runner("gather")
+    docs = texts_to_bytes(["abab", "zz"])
+    direct = runner.score(docs)
+    plan = FaultPlan.parse("seed=7;serve/cache:error%0.5")
+    with ContinuousBatcher(runner, max_wait_ms=2, max_rows=64) as b:
+        with faults.plan_scope(plan):
+            before = _counter("cache/faults")
+            for _ in range(6):
+                got = b.submit(docs).result()
+                np.testing.assert_array_equal(got.values, direct)
+            faulted = _counter("cache/faults") - before
+    assert faulted > 0  # the plan demonstrably fired ...
+    # ... and every answer above was still bit-exact (asserted in-loop).
+
+
+def test_cache_fault_replay_is_deterministic():
+    """Same plan, same op sequence ⇒ the same calls fault — the fleet/*
+    replay discipline applied to the cache site."""
+    plan_text = "seed=11;serve/cache:error%0.4"
+
+    def run_once():
+        fired = []
+        cache = ScoreCache(max_rows=32, max_bytes=1 << 20)
+        with faults.plan_scope(FaultPlan.parse(plan_text)):
+            for i in range(20):
+                before = _counter("cache/faults")
+                if i % 2:
+                    cache.put(
+                        "v1", "labels", "utf-8", b"k%d" % i, np.int32(i)
+                    )
+                else:
+                    cache.get("v1", "labels", "utf-8", b"k%d" % i)
+                fired.append(_counter("cache/faults") - before)
+        return fired
+
+    assert run_once() == run_once()
+
+
+# ------------------------------------------------------------ stream path ---
+def test_stream_checkpoint_resume_with_dedup(tmp_path):
+    """Kill-and-resume with duplicated rows and dedup on: nothing is
+    re-emitted, nothing is lost, outputs match the direct transform."""
+    from spark_languagedetector_tpu.stream.microbatch import (
+        memory_source,
+        run_stream,
+    )
+
+    model = _model(1)
+    rows = [
+        {"fulltext": t}
+        for t in ["abab", "zz", "abab", "abczz", "zz", "abab", "bcbc", "zz"]
+    ]
+    ck = str(tmp_path / "resume.json")
+    sunk: list = []
+    q1 = run_stream(
+        model, memory_source(rows, 2), sunk.append,
+        checkpoint_path=ck, max_batches=2,
+    )
+    assert q1.batches == 2
+    q2 = run_stream(
+        model, memory_source(rows, 2), sunk.append, checkpoint_path=ck
+    )
+    assert q2.resumed_from == 2
+    got = [v for t in sunk for v in t.column("lang").tolist()]
+    want = model.transform(
+        Table({"fulltext": [r["fulltext"] for r in rows]})
+    ).column("lang").tolist()
+    assert got == want
+    assert q1.batches + q2.batches == 4
+
+
+def test_stream_poison_rows_quarantine_with_dedup(tmp_path):
+    """A poisoned duplicate fails alone: its healthy twin (same text,
+    clean encode) still scores, and only the poison row lands in the DLQ."""
+    from spark_languagedetector_tpu.resilience.dlq import DeadLetterQueue
+    from spark_languagedetector_tpu.stream.microbatch import (
+        memory_source,
+        run_stream,
+    )
+
+    model = _model(1)
+    rows = [{"fulltext": t} for t in ["abab", "abab", "zz", "zz"]]
+    dlq = DeadLetterQueue(str(tmp_path / "dlq"))
+    sunk: list = []
+    plan = FaultPlan.parse("seed=3;stream/batch:poison=1@1")
+    with faults.plan_scope(plan):
+        q = run_stream(
+            model, memory_source(rows, 4), sunk.append, dlq=dlq
+        )
+    assert q.dlq_rows == 1
+    healthy = sum(t.num_rows for t in sunk)
+    assert healthy == 3
+    want = model.transform(
+        Table({"fulltext": ["abab", "abab", "zz", "zz"]})
+    ).column("lang").tolist()
+    got = [v for t in sunk for v in t.column("lang").tolist()]
+    # The three healthy rows keep source order and exact values.
+    assert all(v in want for v in got)
+
+
+# ------------------------------------------------------------- fit dedup ----
+def test_fit_dedup_bit_identical_to_host_fit():
+    from spark_languagedetector_tpu.ops import fit as fit_ops
+    from spark_languagedetector_tpu.ops import fit_tpu
+    from spark_languagedetector_tpu.ops.vocab import HASHED
+
+    rng = np.random.default_rng(13)
+    base = [
+        bytes(rng.integers(97, 107, int(rng.integers(2, 40)), dtype=np.uint8))
+        for _ in range(10)
+    ]
+    docs = [base[int(i)] for i in rng.integers(0, 10, 60)]
+    langs = np.asarray([i % 3 for i in range(60)], dtype=np.int32)
+    spec = VocabSpec(HASHED, (1, 2), hash_bits=10)
+    ids_h, w_h = fit_ops.fit_profile_numpy(docs, langs, 3, spec, 40, "parity")
+    ids_d, w_d = fit_tpu.fit_profile_device(docs, langs, 3, spec, 40, "parity")
+    np.testing.assert_array_equal(ids_h, ids_d)
+    np.testing.assert_array_equal(w_h, w_d)
+
+
+def test_plan_fit_batches_dedup_mult():
+    from spark_languagedetector_tpu.ops import fit_pipeline as fp
+
+    docs = [b"aa", b"bb", b"aa", b"aa", b"bb", b"cc"]
+    langs = np.asarray([0, 1, 0, 0, 1, 0], dtype=np.int32)
+    items, item_langs, plan, straddle, mult = fp.plan_fit_batches(
+        docs, langs, SPEC12
+    )
+    assert mult is not None
+    assert sorted(zip(items, mult.tolist())) == [
+        (b"aa", 3), (b"bb", 2), (b"cc", 1)
+    ]
+    # Same doc under different langs stays distinct.
+    items2, _, _, _, mult2 = fp.plan_fit_batches(
+        [b"aa", b"aa"], np.asarray([0, 1]), SPEC12
+    )
+    assert mult2 is None and len(items2) == 2
+    # Knob off: no dedup, no mult.
+    items3, _, _, _, mult3 = fp.plan_fit_batches(
+        docs, langs, SPEC12, dedup=False
+    )
+    assert mult3 is None and len(items3) == 6
+
+
+# --------------------------------------------------------- compare guard ----
+def _capture(hits, lookups, uniq, rows_in):
+    return [
+        {"event": "telemetry.span", "path": "score", "wall_s": 0.5},
+        {
+            "event": "telemetry.snapshot",
+            "counters": {
+                "cache/hits": hits, "cache/lookups": lookups,
+                "dedup/rows_unique": uniq, "dedup/rows_in": rows_in,
+            },
+            "histograms": {}, "gauges": {},
+        },
+    ]
+
+
+def test_compare_tracks_cache_hit_rate_downward():
+    from spark_languagedetector_tpu.telemetry import compare
+
+    base = compare.capture_stats(_capture(80, 100, 30, 100))
+    assert base["tracked"]["cache/hit_rate"] == pytest.approx(0.8)
+    assert base["tracked"]["dedup/unique_ratio"] == pytest.approx(0.3)
+    worse = compare.capture_stats(_capture(20, 100, 30, 100))
+    _, regressions = compare.compare_captures(base, worse)
+    assert any("cache/hit_rate" in r for r in regressions)
+    better = compare.capture_stats(_capture(95, 100, 30, 100))
+    _, regressions = compare.compare_captures(base, better)
+    assert not any("cache/hit_rate" in r for r in regressions)
+
+
+def test_compare_tracks_dedup_unique_ratio_upward():
+    from spark_languagedetector_tpu.telemetry import compare
+
+    base = compare.capture_stats(_capture(80, 100, 30, 100))
+    worse = compare.capture_stats(_capture(80, 100, 90, 100))  # dedup broke
+    _, regressions = compare.compare_captures(base, worse)
+    assert any("dedup/unique_ratio" in r for r in regressions)
+    better = compare.capture_stats(_capture(80, 100, 20, 100))
+    _, regressions = compare.compare_captures(base, better)
+    assert not any("dedup/unique_ratio" in r for r in regressions)
+
+
+# ------------------------------------------------------------- autotuner ----
+def _tune_events(with_cache=True):
+    counters = {"exec/len/128": 50, "exec/len/256": 20}
+    if with_cache:
+        counters.update({
+            "cache/lookups": 1000, "cache/hits": 700,
+            "cache/bytes_saved": 119000,  # 700 hits x 170B served docs
+            "dedup/rows_in": 2000, "dedup/rows_unique": 600,
+        })
+    return [
+        {"ts": 100.0, "event": "telemetry.snapshot", "counters": counters,
+         "histograms": {}, "gauges": {}},
+    ]
+
+
+def test_tune_solves_cache_sizing_from_duplicate_mass():
+    from spark_languagedetector_tpu.exec import tune
+
+    prof = tune.solve(_tune_events())
+    assert prof.tuned["cache_rows"] >= 1024
+    assert prof.tuned["cache_rows"] & (prof.tuned["cache_rows"] - 1) == 0
+    assert prof.tuned["cache_bytes"] >= 1 << 20
+    assert prof.source["duplicate_mass"] == pytest.approx(0.7)
+    # Deterministic: same capture, same profile version.
+    assert tune.solve(_tune_events()).version == prof.version
+    # No cache traffic observed: nothing recorded as tuned.
+    bare = tune.solve(_tune_events(with_cache=False))
+    assert "cache_rows" not in bare.tuned
+    assert "cache_bytes" not in bare.tuned
+
+
+def test_tune_solves_cache_sizing_from_hits_alone():
+    """Steady-state serve capture: cross-dispatch repeats are absorbed as
+    cache HITS and never reach the runner, so the dedup counters read
+    all-unique — the hit evidence alone must still size the cache."""
+    from spark_languagedetector_tpu.exec import tune
+
+    counters = {
+        "exec/len/128": 50,
+        "cache/lookups": 1000, "cache/hits": 700,
+        "cache/bytes_saved": 119000,
+        "dedup/rows_in": 300, "dedup/rows_unique": 300,
+    }
+    events = [
+        {"ts": 100.0, "event": "telemetry.snapshot", "counters": counters,
+         "histograms": {}, "gauges": {}},
+    ]
+    prof = tune.solve(events)
+    assert prof.tuned["cache_rows"] >= 1024
+    assert prof.tuned["cache_bytes"] >= 1 << 20
+    assert prof.source["duplicate_mass"] == 0.0  # dedup saw none
+
+
+def test_cache_knobs_resolve_from_profile(tmp_path, monkeypatch):
+    from spark_languagedetector_tpu.exec.profile import TuningProfile
+
+    prof = TuningProfile(tuned={"cache_rows": 2048, "cache_bytes": 1 << 21})
+    path = str(tmp_path / "prof.json")
+    prof.save(path)
+    monkeypatch.setenv(exec_config.PROFILE_ENV, path)
+    exec_config.reload_profile()
+    try:
+        value, source = exec_config.resolve_with_source("cache_rows")
+        assert (value, source) == (2048, "profile")
+        cache = ScoreCache()
+        assert cache.max_rows == 2048 and cache.max_bytes == 1 << 21
+        # env still beats the profile
+        monkeypatch.setenv("LANGDETECT_CACHE_ROWS", "4096")
+        assert exec_config.resolve("cache_rows") == 4096
+    finally:
+        monkeypatch.delenv(exec_config.PROFILE_ENV)
+        exec_config.reload_profile()
+
+
+# --------------------------------------------------------------- the gate ---
+def test_bench_smoke_cache_trimmed(tmp_path):
+    """Tier-1-sized redundancy smoke: Zipf-duplicated corpus through
+    batch, stream, and the 2-replica fleet with a mid-run hot-swap —
+    parity/staleness/hit-rate hard gates exactly like the CI gate (the
+    two wall-clock gates run full-size only)."""
+    import bench
+
+    result = bench.smoke_cache(str(tmp_path / "cache.jsonl"), trimmed=True)
+    assert result["ok"], result
+    assert result["batch"]["bit_exact"] and result["batch"]["argmax_parity"] == 1.0
+    assert result["stream"]["parity"] == 1.0
+    assert result["fleet"]["per_version_parity"] == 1.0
+    assert result["fleet"]["stale_answers"] == 0
+    assert result["cache"]["hits"] > 0
+    assert result["dedup"]["rows_unique"] < result["dedup"]["rows_in"]
+    assert result["wire_bytes_saved"] > 0
+
+
+@pytest.mark.slow
+def test_bench_smoke_cache_full(tmp_path):
+    """Full-size smoke incl. the >=1.5x duplicated-corpus and <=3%
+    all-unique wall-clock gates (slow-marked: CI runs it via
+    ``bench.py --smoke-cache``)."""
+    import bench
+
+    result = bench.smoke_cache(str(tmp_path / "cache_full.jsonl"))
+    assert result["ok"], result
+    assert result["batch"]["speedup_duplicated"] >= 1.5
+    assert result["batch"]["overhead_all_unique"] <= 0.03

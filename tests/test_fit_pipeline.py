@@ -41,7 +41,7 @@ def test_plan_adaptive_rows_respect_byte_budget():
     langs = np.arange(300) % 4
     spec = VocabSpec(HASHED, (1, 2), hash_bits=10)
     budget = 1 << 18  # 256KB: forces halving on the wide buckets
-    items, item_langs, plan, straddle = fp.plan_fit_batches(
+    items, item_langs, plan, straddle, _ = fp.plan_fit_batches(
         docs, langs, spec, byte_budget=budget
     )
     assert straddle is None  # nothing oversized
@@ -61,7 +61,7 @@ def test_plan_fixed_rows_slices_sorted_order():
     rng = np.random.default_rng(9)
     docs, langs = _corpus(rng, 41, 3)
     spec = VocabSpec(EXACT, (1, 2))
-    items, item_langs, plan, _ = fp.plan_fit_batches(
+    items, item_langs, plan, _, _ = fp.plan_fit_batches(
         docs, langs, spec, batch_rows=16
     )
     assert [len(sel) for sel, _ in plan] == [16, 16, 9]
@@ -119,7 +119,7 @@ def test_plan_pins_compiled_shapes_for_oversized_docs():
         docs.append(bytes(rng.integers(97, 105, extra, dtype=np.uint8)))
         langs = np.concatenate([langs, [0]])
     spec = VocabSpec(HASHED, (1, 2, 3), hash_bits=12)
-    items, _, plan, straddle = fp.plan_fit_batches(docs, langs, spec)
+    items, _, plan, straddle, _ = fp.plan_fit_batches(docs, langs, spec)
     assert all(pad_to in DEFAULT_LENGTH_BUCKETS for _, pad_to in plan)
     assert max(len(it) for it in items) <= MAX_BUCKET
     assert straddle is not None and straddle[2].sum() > 0

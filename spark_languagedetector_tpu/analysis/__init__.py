@@ -1,0 +1,37 @@
+"""Static contract checker: prove the string-keyed contracts the stack leans on.
+
+Five PRs of growth made correctness hinge on cross-module *string*
+contracts — every ``LANGDETECT_*`` knob resolves through
+:mod:`..exec.config`'s audited precedence table, every counter name
+:mod:`..telemetry.compare` and :mod:`..exec.tune` consume must actually be
+emitted somewhere, every ``faults.inject(site)`` literal must be a row in
+:data:`..resilience.faults.SITES`, and the OBSERVABILITY/RESILIENCE doc
+tables must describe what the code really does. Until this module those
+contracts were enforced by reviewer vigilance alone; now they are
+machine-verified by a pure-stdlib AST pass that runs in tier-1::
+
+    python -m spark_languagedetector_tpu.analysis.check [--json]
+
+No JAX import, no device work, <5s — the checker never imports the
+modules it audits; it parses them (:mod:`.harvest`) and applies the rule
+families (:mod:`.rules`):
+
+  * **R1 knob discipline** — env reads of ``LANGDETECT_*`` outside
+    ``exec/config.py``; knob literals without a ``KNOBS`` row; knobs the
+    OBSERVABILITY.md env table doesn't cover.
+  * **R2 telemetry name contract** — names ``telemetry/compare`` /
+    ``exec/tune`` consume but nothing emits; emitted names that break the
+    ``area/name`` slash-path grammar; doc'd metrics nothing emits.
+  * **R3 fault-site registry** — ``inject()`` literals vs ``SITES`` vs
+    RESILIENCE.md §4, all three ways.
+  * **R4 trace purity** — host-impure calls (env/time/random/telemetry/
+    print) inside jit/pjit/shard_map/pallas_call-traced functions.
+  * **R5 suppression audit** — ``# contract: ignore[R?] -- reason``
+    pragmas and the checked-in :mod:`.allowlist`; stale suppressions are
+    themselves violations.
+
+See docs/ANALYSIS.md for the rule catalog, the pragma/allowlist grammar,
+and how to add a rule.
+"""
+
+from .check import Violation, run_checks  # noqa: F401

@@ -325,6 +325,21 @@ def resolve(name: str, explicit=None, env=os.environ):
     return resolve_with_source(name, explicit, env)[0]
 
 
+def raw_env(name: str, env=os.environ) -> str | None:
+    """The raw, unparsed env string behind a knob (diagnostics only).
+
+    For error paths that want to *show* what the operator typed without
+    re-spelling the env-var name at the call site — the one place a
+    module outside this file may touch a knob's environment string.
+    """
+    knob = KNOBS.get(name)
+    if knob is None:
+        raise ValueError(
+            f"unknown config knob {name!r}; expected one of {sorted(KNOBS)}"
+        )
+    return env.get(knob.env) if knob.env else None
+
+
 def effective_config(env=os.environ) -> dict:
     """Every knob's live value + provenance — the ``/varz`` and bench
     audit block. Malformed env values surface as ``"error"`` entries
@@ -363,3 +378,14 @@ def effective_config(env=os.environ) -> dict:
         }
         out["knobs"][name] = entry
     return out
+
+
+# The logging root's level was set pre-config (bootstrap: this module's
+# own imports emit through it, so the knob table cannot exist yet when
+# the root initializes). Re-resolve it through the audited table now that
+# the table does exist — the live level and the /varz report can't
+# disagree, and the bootstrap read stays the one allowlisted exception
+# (analysis/allowlist.py).
+from ..utils.logging import sync_level_from_config as _sync_level  # noqa: E402
+
+_sync_level(resolve)

@@ -168,7 +168,7 @@ def default_pack_threads() -> int:
     except ValueError:
         log_event(
             _log, "native.bad_pack_threads",
-            value=os.environ.get("LANGDETECT_PACK_THREADS"),
+            value=exec_config.raw_env("pack_threads"),
         )
         threads = None
     if threads is not None:

@@ -15,11 +15,10 @@ and globally-addressed arrays are assembled with
 
 from __future__ import annotations
 
-import os
-
 import jax
 import numpy as np
 
+from ..exec import config as exec_config
 from ..utils.logging import get_logger, log_event
 
 _log = get_logger("parallel.distributed")
@@ -36,15 +35,18 @@ def initialize(
     server; elsewhere pass them explicitly or via the env vars
     ``LANGDETECT_TPU_COORDINATOR`` / ``LANGDETECT_TPU_NUM_PROCESSES`` /
     ``LANGDETECT_TPU_PROCESS_ID``, mirroring ``jax.distributed.initialize``.
+    The env spellings resolve through ``exec/config``'s audited table
+    (type-validated, surfaced in ``/varz`` ``effective_config``) — the
+    table itself is importable without JAX, so the bring-up knobs are
+    readable before any backend initializes.
     """
-    if coordinator_address is None:
-        coordinator_address = os.environ.get("LANGDETECT_TPU_COORDINATOR")
-    if num_processes is None:
-        env_procs = os.environ.get("LANGDETECT_TPU_NUM_PROCESSES")
-        num_processes = int(env_procs) if env_procs else None
-    if process_id is None:
-        env_pid = os.environ.get("LANGDETECT_TPU_PROCESS_ID")
-        process_id = int(env_pid) if env_pid else None
+    coordinator_address = exec_config.resolve(
+        "tpu_coordinator", explicit=coordinator_address
+    )
+    num_processes = exec_config.resolve(
+        "tpu_num_processes", explicit=num_processes
+    )
+    process_id = exec_config.resolve("tpu_process_id", explicit=process_id)
     if coordinator_address is None and num_processes in (None, 1):
         log_event(_log, "distributed.single_process")
         return

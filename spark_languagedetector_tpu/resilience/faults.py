@@ -304,8 +304,12 @@ def plan_scope(plan: FaultPlan):
 
 def install_from_env(env=os.environ) -> FaultPlan | None:
     """Arm the env-declared plan; None when unset. Raises on a bad spec —
-    a typo'd chaos schedule must be loud, not a silently clean run."""
-    spec = env.get(FAULT_PLAN_ENV, "").strip()
+    a typo'd chaos schedule must be loud, not a silently clean run. The
+    knob resolves through exec/config's audited table (imported lazily:
+    this runs at package-import time, before the exec package is up)."""
+    from ..exec import config as exec_config
+
+    spec = (exec_config.resolve("fault_plan", env=env) or "").strip()
     if not spec:
         return None
     return install(FaultPlan.parse(spec))

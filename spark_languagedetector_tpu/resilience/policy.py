@@ -68,22 +68,12 @@ def is_retryable(exc: BaseException) -> bool:
     return isinstance(exc, _RETRYABLE_BASES)
 
 
-def _env_float(env: dict, key: str, default: float) -> float:
-    try:
-        return float(env.get(key, "") or default)
-    except ValueError:
-        return default
-
-
-def _env_int(env: dict, key: str, default: int) -> int:
-    try:
-        return int(env.get(key, "") or default)
-    except ValueError:
-        return default
-
-
 # Env knobs (docs/RESILIENCE.md §2): one shared namespace — per-site
 # policies are constructed in code, the env sets the process default.
+# The spellings below are kept as importable constants (tests pin them);
+# the *reads* resolve through exec/config's audited table, so a malformed
+# value raises instead of silently meaning "default" and /varz reports
+# exactly what a policy built from the environment will do.
 RETRY_ATTEMPTS_ENV = "LANGDETECT_RETRY_MAX_ATTEMPTS"
 RETRY_BASE_DELAY_ENV = "LANGDETECT_RETRY_BASE_DELAY_S"
 RETRY_MAX_DELAY_ENV = "LANGDETECT_RETRY_MAX_DELAY_S"
@@ -122,16 +112,22 @@ class RetryPolicy:
     @staticmethod
     def from_env(env=os.environ, **overrides) -> "RetryPolicy":
         """Process-default policy from ``LANGDETECT_RETRY_*``; keyword
-        overrides win (call sites pin what must not drift)."""
-        deadline = env.get(RETRY_DEADLINE_ENV, "").strip()
+        overrides win (call sites pin what must not drift). Knobs resolve
+        through exec/config's audited precedence table — a malformed
+        value raises rather than silently meaning the default."""
+        from ..exec import config as exec_config
+
+        def knob(name):
+            return exec_config.resolve(name, env=env)
+
         kw = dict(
-            max_attempts=max(1, _env_int(env, RETRY_ATTEMPTS_ENV, 2)),
-            base_delay_s=_env_float(env, RETRY_BASE_DELAY_ENV, 0.05),
-            multiplier=_env_float(env, RETRY_MULTIPLIER_ENV, 2.0),
-            max_delay_s=_env_float(env, RETRY_MAX_DELAY_ENV, 2.0),
-            jitter=min(1.0, max(0.0, _env_float(env, RETRY_JITTER_ENV, 0.5))),
-            seed=_env_int(env, RETRY_SEED_ENV, 0),
-            attempt_deadline_s=float(deadline) if deadline else None,
+            max_attempts=max(1, int(knob("retry_max_attempts"))),
+            base_delay_s=knob("retry_base_delay_s"),
+            multiplier=knob("retry_multiplier"),
+            max_delay_s=knob("retry_max_delay_s"),
+            jitter=min(1.0, max(0.0, knob("retry_jitter"))),
+            seed=knob("retry_seed"),
+            attempt_deadline_s=knob("retry_attempt_deadline_s"),
         )
         kw.update(overrides)
         return RetryPolicy(**kw)
@@ -306,10 +302,16 @@ class CircuitBreaker:
 
     @staticmethod
     def from_env(env=os.environ, *, name: str = "device") -> "CircuitBreaker":
+        from ..exec import config as exec_config
+
         return CircuitBreaker(
-            failure_threshold=max(1, _env_int(env, BREAKER_THRESHOLD_ENV, 5)),
-            cooldown_s=_env_float(env, BREAKER_COOLDOWN_ENV, 5.0),
-            probe_successes=max(1, _env_int(env, BREAKER_PROBES_ENV, 1)),
+            failure_threshold=max(
+                1, int(exec_config.resolve("breaker_threshold", env=env))
+            ),
+            cooldown_s=exec_config.resolve("breaker_cooldown_s", env=env),
+            probe_successes=max(
+                1, int(exec_config.resolve("breaker_probes", env=env))
+            ),
             name=name,
         )
 

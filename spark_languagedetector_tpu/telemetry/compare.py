@@ -67,6 +67,28 @@ _TRACKED_GAUGES = {
 # a change that quietly unfills the compiled shapes (lattice drift, a
 # mis-tuned profile, a coalescing regression) fails here even when every
 # latency percentile held steady.
+# Recovery-behavior counters the guard diffs as reliability regressions
+# (a zero-baseline appearance regresses — see compare_captures). Only
+# counters that measure *rejection or recovery* belong here; throughput
+# counters (serve/coalesced_rows) and good-news counters
+# (fleet/readmissions) legitimately grow. Module-level on purpose: these
+# names are a cross-module contract with the emit sites, and the static
+# contract checker (analysis/, R2) verifies every row is actually
+# emitted somewhere — a misspelled or retired counter fails tier-1
+# instead of silently never regressing.
+_RELIABILITY_COUNTER_PREFIXES = ("resilience/", "serve/shed")
+_RELIABILITY_COUNTERS = (
+    "score/retries",
+    "stream/retries",
+    "serve/deadline_rejects",
+    "serve/dispatch_errors",
+    "serve/client_retries",
+    "fleet/failovers",
+    "fleet/ejections",
+    "fleet/shed_requests",
+    "fleet/swap_aborts",
+)
+
 _TRACKED_RATIOS = {
     "fill_ratio[score/wire]": ("score/real_bytes", "score/capacity_bytes"),
     "fill_ratio[fit/wire]": ("fit/real_bytes", "fit/capacity_bytes"),
@@ -208,28 +230,15 @@ def capture_stats(events: list[dict]) -> dict:
         # failovers/ejections/swap aborts): a regression here is a
         # reliability story even when every latency percentile held
         # steady, so the guard diffs them like any other metric
-        # (docs/RESILIENCE.md §7, docs/SERVING.md §6, §9). Only the
-        # counters that measure *rejection or recovery* regress —
-        # throughput counters like serve/coalesced_rows (and good-news
-        # fleet counters like fleet/readmissions) legitimately grow.
+        # (docs/RESILIENCE.md §7, docs/SERVING.md §6, §9).
         cpayload = ev.get("counters")
         if isinstance(cpayload, dict):
             counters = {
                 str(k): v for k, v in cpayload.items()
                 if isinstance(v, (int, float))
                 and (
-                    str(k).startswith(("resilience/", "serve/shed"))
-                    or str(k) in (
-                        "score/retries",
-                        "stream/retries",
-                        "serve/deadline_rejects",
-                        "serve/dispatch_errors",
-                        "serve/client_retries",
-                        "fleet/failovers",
-                        "fleet/ejections",
-                        "fleet/shed_requests",
-                        "fleet/swap_aborts",
-                    )
+                    str(k).startswith(_RELIABILITY_COUNTER_PREFIXES)
+                    or str(k) in _RELIABILITY_COUNTERS
                 )
             }
     return {

@@ -58,11 +58,16 @@ _COMPILE_FOR_COST_MAX_ELEMS = 1 << 24
 
 
 def peak_rates(platform: str, env=os.environ) -> tuple[float, float] | None:
-    """(peak flops/s, peak bytes/s) for a platform; env vars override."""
+    """(peak flops/s, peak bytes/s) for a platform; env vars override
+    (resolved through exec/config's audited table; a malformed override
+    is ignored here — the roofline gauges are advisory — but still shows
+    as an ``error`` row in ``/varz`` ``effective_config``)."""
+    from ..exec import config as exec_config
+
     base = _PLATFORM_PEAKS.get(platform)
     try:
-        flops = float(env.get(PEAK_FLOPS_ENV, "") or 0) or None
-        byts = float(env.get(PEAK_BYTES_ENV, "") or 0) or None
+        flops = exec_config.resolve("peak_flops", env=env) or None
+        byts = exec_config.resolve("peak_bytes_per_s", env=env) or None
     except ValueError:
         flops = byts = None
     if base is None and flops is None and byts is None:

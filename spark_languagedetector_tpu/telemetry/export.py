@@ -208,9 +208,12 @@ def configure_sinks_from_env(registry: Registry, env=os.environ) -> list:
 
     All-or-nothing: every sink is constructed before any is attached, so a
     failing entry (unwritable path) can't leave a partial capture running
-    behind an "env var ignored" warning.
+    behind an "env var ignored" warning. The knob resolves through
+    exec/config's audited table (lazily — this armed at package import).
     """
-    spec = env.get(SINK_ENV, "")
+    from ..exec import config as exec_config
+
+    spec = exec_config.resolve("metrics_sink", env=env) or ""
     if not spec:
         return []
     sinks: list = []

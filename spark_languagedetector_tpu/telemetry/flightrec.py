@@ -135,8 +135,16 @@ def uninstall(registry: Registry | None = None) -> None:
 def install_from_env(
     registry: Registry | None = None, env=os.environ
 ) -> FlightRecorder | None:
-    """Install per ``LANGDETECT_FLIGHT_RECORDER``; None when unset/disabled."""
-    spec = env.get(FLIGHT_ENV, "").strip()
+    """Install per ``LANGDETECT_FLIGHT_RECORDER``; None when unset/disabled.
+
+    Knobs resolve through exec/config's audited table (lazily — this is
+    armed at package import). A malformed capacity keeps the default:
+    the recorder is a crash diagnostic, and refusing to arm it over a
+    typo would lose exactly the dump the typo'd run needed.
+    """
+    from ..exec import config as exec_config
+
+    spec = (exec_config.resolve("flight_recorder", env=env) or "").strip()
     if not spec or spec.lower() in ("0", "false"):
         return None
     if spec.lower() in ("1", "true"):
@@ -144,7 +152,7 @@ def install_from_env(
     else:
         out_dir = spec
     try:
-        capacity = int(env.get(CAPACITY_ENV, "") or DEFAULT_CAPACITY)
+        capacity = exec_config.resolve("flight_recorder_events", env=env)
     except ValueError:
         capacity = DEFAULT_CAPACITY
     return install(out_dir, capacity, registry)

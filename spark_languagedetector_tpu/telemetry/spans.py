@@ -85,7 +85,17 @@ class Span:
 def _fencing_enabled(flag: bool | None) -> bool:
     if flag is not None:
         return flag
-    return os.environ.get(FENCE_ENV, "") in ("1", "true", "TRUE")
+    # Resolved through exec/config's audited table (lazily: spans import
+    # before the exec package exists). A malformed value means "off" —
+    # fencing is a profiling mode, and raising here would fail every
+    # span() on the hot path — but still surfaces as an ``error`` row in
+    # /varz effective_config.
+    from ..exec import config as exec_config
+
+    try:
+        return bool(exec_config.resolve("telemetry_fence"))
+    except ValueError:
+        return False
 
 
 def _resolve_path(name: str, parent: "Span | None") -> str:

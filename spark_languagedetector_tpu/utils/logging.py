@@ -25,7 +25,19 @@ if not _root.handlers:
         logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
     )
     _root.addHandler(_handler)
-    _root.setLevel(os.environ.get("LANGDETECT_TPU_LOGLEVEL", "WARNING").upper())
+    # Bootstrap read: exec/config's own imports log through this root, so
+    # the audited knob table cannot exist yet. config.py re-applies the
+    # level through the table (sync_level_from_config) the moment it
+    # finishes importing; this direct read is the one allowlisted
+    # exception (analysis/allowlist.py, docs/ANALYSIS.md §4). A bad
+    # value keeps the default rather than making the package
+    # unimportable — same tolerance as the post-config re-sync below.
+    _level = os.environ.get("LANGDETECT_TPU_LOGLEVEL", "WARNING").upper()
+    try:
+        _root.setLevel(_level)
+    except ValueError:
+        _root.setLevel(logging.WARNING)
+        _root.warning("LANGDETECT_TPU_LOGLEVEL ignored: unknown level %r", _level)
     _root.propagate = False
 
 
@@ -36,6 +48,23 @@ def get_logger(module: str) -> logging.Logger:
 
 def set_level(level: str) -> None:
     _root.setLevel(level.upper())
+
+
+def sync_level_from_config(resolve) -> None:
+    """Re-resolve the root level through exec/config's audited table.
+
+    Called by ``exec.config`` at the end of its own module body (the
+    resolver is passed in, keeping this bootstrap module free of package
+    imports): the pre-config bootstrap value above is replaced by the
+    table-resolved one, so the live level always matches what ``/varz``
+    ``effective_config`` reports for ``loglevel``.
+    """
+    try:
+        level = resolve("loglevel")
+        if level:
+            _root.setLevel(str(level).upper())
+    except ValueError as e:
+        _root.warning("LANGDETECT_TPU_LOGLEVEL ignored: %s", e)
 
 
 def log_event(logger: logging.Logger, event: str, **fields: Any) -> None:

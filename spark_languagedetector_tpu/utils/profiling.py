@@ -47,7 +47,10 @@ def trace(log_dir: str | None = None, label: str | None = None):
     traced region still marks the capture finished (and the telemetry span
     still records), instead of silently swallowing the event.
     """
-    log_dir = log_dir or os.environ.get(TRACE_DIR_ENV)
+    if log_dir is None:
+        from ..exec import config as exec_config
+
+        log_dir = exec_config.resolve("trace_dir")
     if not log_dir:
         yield
         return

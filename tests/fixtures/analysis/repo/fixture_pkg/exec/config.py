@@ -1,0 +1,6 @@
+"""Fixture knob table (never imported — the checker parses it)."""
+
+KNOBS = _knobs(
+    Knob("alpha", "LANGDETECT_ALPHA", "int", 1, "fixture alpha knob"),
+    Knob("beta", "LANGDETECT_BETA", "str", None, "fixture beta knob"),
+)

@@ -1,0 +1,10 @@
+"""Fixture tuner consumption (never imported — the checker parses it)."""
+
+LEN_BIN_PREFIX = "exec/len/"
+
+
+def signals(counters, hists):
+    good = counters.get("good/counter")
+    ghost = counters.get("ghost/tuner_counter")  # seeded R2: never emitted
+    hist = hists.get("good/hist")
+    return good, ghost, hist

@@ -1,0 +1,22 @@
+"""Seeded R1 violations: direct knob reads outside exec/config."""
+
+import os
+
+GHOST_ENV = "LANGDETECT_GHOST_KNOB"  # seeded R1: no KNOBS row
+ANN_ENV: str = "LANGDETECT_BETA"  # annotated-constant spelling
+
+
+def bad_get():
+    return os.environ.get("LANGDETECT_ALPHA")  # seeded R1: direct read
+
+
+def bad_annassign_const():
+    return os.environ.get(ANN_ENV)  # seeded R1: read via annotated constant
+
+
+def bad_subscript():
+    return os.environ[GHOST_ENV]  # seeded R1: direct read via constant
+
+
+def bad_getenv():
+    return os.getenv("LANGDETECT_BETA")  # seeded R1: direct read

@@ -1,0 +1,15 @@
+"""Telemetry emit sites: the good names every consumer needs, plus
+seeded R2 grammar violations."""
+
+
+def emit(edge):
+    REGISTRY.incr("good/counter")
+    REGISTRY.incr("good/total")
+    REGISTRY.incr("good/retries")
+    REGISTRY.observe("good/hist", 1.0)
+    REGISTRY.set_gauge("langdetect_fixture_gauge", 2.0)
+    REGISTRY.incr(f"exec/len/{edge}")
+    REGISTRY.incr("BadGrammarName")  # seeded R2: grammar
+    REGISTRY.observe("no_slash_name", 1.0)  # seeded R2: grammar
+    with span("score/pack"):
+        pass

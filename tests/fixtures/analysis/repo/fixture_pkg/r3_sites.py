@@ -1,0 +1,6 @@
+"""Seeded R3 violation: an inject literal outside the SITES registry."""
+
+
+def work(faults):
+    faults.inject("score/dispatch")
+    faults.inject("not/a_site")  # seeded R3: not in SITES

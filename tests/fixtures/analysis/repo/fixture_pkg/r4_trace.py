@@ -1,0 +1,31 @@
+"""Seeded R4 violations: host-impure calls inside traced functions."""
+
+
+@partial(jax.jit, static_argnames=("n",))
+def traced_decorated(x, *, n):
+    t = time.perf_counter()  # seeded R4: baked at trace time
+    print(x)  # seeded R4: per-trace no-op
+    return x + n + t
+
+
+def wrapped_helper(x):
+    v = np.random.rand()  # seeded R4: host RNG
+    REGISTRY.incr("good/counter")  # seeded R4: telemetry emission
+    return x * v
+
+
+wrapped = jax.jit(wrapped_helper)
+
+
+def kernel_fn(ref):
+    home = os.environ.get("HOME")  # seeded R4: env read under pallas
+    ref[...] = 0 if home else 1
+
+
+kernel = pl.pallas_call(kernel_fn, out_shape=None)
+
+
+def host_side_is_fine(x):
+    # Not traced: the same calls are legal on the host.
+    print(x)
+    return time.perf_counter()

@@ -1,0 +1,21 @@
+"""Suppression fixtures: honored pragmas, a stale one, a bogus rule id."""
+
+import os
+
+
+def suppressed_inline():
+    return os.environ.get("LANGDETECT_ALPHA")  # contract: ignore[R1] -- fixture: same-line suppression form
+
+
+def suppressed_above():
+    # contract: ignore[R1] -- fixture: pragma-above suppression form
+    return os.environ.get("LANGDETECT_ALPHA")
+
+
+# contract: ignore[R3] -- fixture: stale, suppresses nothing
+def nothing_to_suppress():
+    return 0
+
+
+def wrong_rule_id():
+    return os.environ.get("LANGDETECT_ALPHA")  # contract: ignore[R9] -- fixture: unknown rule id

@@ -1,0 +1,6 @@
+"""Fixture fault-site registry (never imported — the checker parses it)."""
+
+SITES = (
+    "score/dispatch",
+    "ghost/site",  # seeded R3: no inject() call site, undocumented in §4
+)

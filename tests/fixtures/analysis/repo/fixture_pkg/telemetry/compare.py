@@ -1,0 +1,17 @@
+"""Fixture compare contracts (never imported — the checker parses it)."""
+
+_TRACKED_GAUGES = {
+    "langdetect_fixture_gauge": "fixture_gauge",
+    "langdetect_ghost_gauge": "ghost_gauge",  # seeded R2: never emitted
+}
+
+_TRACKED_RATIOS = {
+    "good/ratio": ("good/counter", "good/total"),
+    "bad/ratio": ("ghost/ratio_counter", "good/total"),  # seeded R2
+}
+
+_RELIABILITY_COUNTER_PREFIXES = ("ghostarea/",)  # seeded R2: no emits under it
+_RELIABILITY_COUNTERS = (
+    "good/retries",
+    "ghost/retries",  # seeded R2: never emitted
+)

@@ -1,0 +1,372 @@
+"""The static contract checker (spark_languagedetector_tpu/analysis).
+
+Two halves:
+
+* **The tier-1 gate** — ``test_shipped_tree_is_clean`` runs the checker
+  over the real package + docs and fails on any unsuppressed violation.
+  This is the enforcement surface every future PR inherits: a stray
+  ``LANGDETECT_*`` read outside exec/config, a counter `compare`/`tune`
+  consume that nothing emits, an unregistered fault site, host-impure
+  code inside a traced function, or a doc table drifting from the code
+  all fail here, with file:line and a fix hint.
+
+* **Mutation-style rule coverage** — a fixture tree
+  (tests/fixtures/analysis/) seeds at least one violation per rule
+  family; each test proves its rule demonstrably *fires* (a checker that
+  silently stopped checking would pass the gate forever). Plus pragma /
+  allowlist suppression semantics, staleness detection, the pinned
+  ``--json`` schema, and the CLI contract.
+
+Pure AST work — no jax import, no device, fast enough for tier-1.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from spark_languagedetector_tpu.analysis import run_checks
+from spark_languagedetector_tpu.analysis.allowlist import ALLOWLIST, Allow
+from spark_languagedetector_tpu.analysis.check import (
+    JSON_SCHEMA_VERSION,
+    RULE_IDS,
+    main as check_main,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "spark_languagedetector_tpu"
+FIXTURE_ROOT = Path(__file__).resolve().parent / "fixtures" / "analysis" / "repo"
+FIXTURE_PKG = FIXTURE_ROOT / "fixture_pkg"
+
+
+@pytest.fixture(scope="module")
+def fixture_report():
+    return run_checks(
+        package_dir=FIXTURE_PKG, repo_root=FIXTURE_ROOT, allowlist=()
+    )
+
+
+def _find(report, rule, file_part, message_part):
+    return [
+        v for v in report.violations
+        if v.rule == rule and file_part in v.file and message_part in v.message
+    ]
+
+
+# ---------------------------------------------------------------- the gate --
+def test_shipped_tree_is_clean():
+    """THE tier-1 gate: zero unsuppressed violations over package + docs.
+
+    If this fails, read the messages — each carries file:line and a fix
+    hint; fix the contract drift (or, for a genuine exception, add a
+    pragma/allowlist entry with a reason — docs/ANALYSIS.md §4).
+    """
+    report = run_checks(package_dir=PACKAGE, repo_root=REPO)
+    rendered = "\n".join(
+        f"{v.rule} {v.file}:{v.line}  {v.message}" for v in report.violations
+    )
+    assert report.ok, f"contract violations in the shipped tree:\n{rendered}"
+
+
+def test_shipped_tree_suppressions_are_live():
+    """Every checked-in allowlist entry still suppresses something (the
+    staleness rule would otherwise fire inside the gate test; this one
+    localizes the diagnosis)."""
+    report = run_checks(package_dir=PACKAGE, repo_root=REPO)
+    allow_used = {
+        s["reason"] for s in report.suppressed if s["via"] == "allowlist"
+    }
+    assert {a.reason for a in ALLOWLIST} == allow_used
+
+
+def test_gate_catches_reverted_knob_fix(tmp_path):
+    """Acceptance pin: re-introducing a raw LANGDETECT_* env read outside
+    exec/config (reverting the satellite fix) fails the gate."""
+    pkg = tmp_path / "spark_languagedetector_tpu"
+    shutil.copytree(
+        PACKAGE, pkg, ignore=shutil.ignore_patterns("__pycache__")
+    )
+    target = pkg / "parallel" / "distributed.py"
+    target.write_text(
+        target.read_text(encoding="utf-8")
+        + "\nimport os\n_RAW = os.environ.get('LANGDETECT_TPU_COORDINATOR')\n",
+        encoding="utf-8",
+    )
+    report = run_checks(package_dir=pkg, repo_root=None)
+    hits = _find(report, "R1", "parallel/distributed.py", "direct env read")
+    assert hits, "the reverted raw env read must fail the analysis gate"
+
+
+# ------------------------------------------------------------ R1 fixtures ---
+def test_r1_direct_env_reads_fire(fixture_report):
+    assert _find(fixture_report, "R1", "r1_env.py", "LANGDETECT_ALPHA")
+    assert _find(fixture_report, "R1", "r1_env.py", "LANGDETECT_BETA")
+    # subscript form, resolved through a module-level constant
+    assert _find(
+        fixture_report, "R1", "r1_env.py",
+        "direct env read of LANGDETECT_GHOST_KNOB",
+    )
+    # .get form, resolved through an ANNOTATED module-level constant
+    # (VAR: str = "LANGDETECT_…") — a missed assign spelling is an R1
+    # bypass, so both forms are pinned
+    assert _find(
+        fixture_report, "R1", "r1_env.py",
+        "direct env read of LANGDETECT_BETA",
+    )
+
+
+def test_r1_unknown_knob_literal_fires(fixture_report):
+    assert _find(
+        fixture_report, "R1", "r1_env.py",
+        "knob literal LANGDETECT_GHOST_KNOB has no exec/config.KNOBS row",
+    )
+
+
+def test_r1_env_table_coverage_fires(fixture_report):
+    assert _find(
+        fixture_report, "R1", "docs/OBSERVABILITY.md",
+        "LANGDETECT_BETA missing from the environment-variable table",
+    )
+
+
+# ------------------------------------------------------------ R2 fixtures ---
+def test_r2_consumed_but_never_emitted_fires(fixture_report):
+    assert _find(
+        fixture_report, "R2", "telemetry/compare.py", "langdetect_ghost_gauge"
+    )
+    assert _find(
+        fixture_report, "R2", "telemetry/compare.py", "ghost/ratio_counter"
+    )
+    assert _find(
+        fixture_report, "R2", "telemetry/compare.py", "ghost/retries"
+    )
+    assert _find(fixture_report, "R2", "telemetry/compare.py", "ghostarea/")
+    assert _find(
+        fixture_report, "R2", "exec/tune.py", "ghost/tuner_counter"
+    )
+
+
+def test_r2_grammar_fires(fixture_report):
+    assert _find(fixture_report, "R2", "r2_names.py", "BadGrammarName")
+    assert _find(fixture_report, "R2", "r2_names.py", "no_slash_name")
+
+
+def test_r2_doc_metric_sync_fires(fixture_report):
+    assert _find(
+        fixture_report, "R2", "docs/OBSERVABILITY.md", "ghost/counter"
+    )
+    assert _find(
+        fixture_report, "R2", "docs/OBSERVABILITY.md",
+        "ghost/span_nobody_emits",
+    )
+    assert _find(
+        fixture_report, "R2", "docs/OBSERVABILITY.md",
+        "langdetect_ghost_doc_gauge",
+    )
+    # sharing only the LEAF segment with a real span (ghost/pack vs the
+    # emitted score/pack) must not satisfy the nesting allowance
+    assert _find(
+        fixture_report, "R2", "docs/OBSERVABILITY.md", "'ghost/pack'"
+    )
+
+
+def test_r2_good_names_pass(fixture_report):
+    """Emitted + consumed + doc'd names that agree produce no noise —
+    including the f-string family (exec/len/<edge>) and the derived
+    tracked-ratio name (good/ratio)."""
+    for good in (
+        "good/counter", "good/hist", "good/retries", "good/ratio",
+        "langdetect_fixture_gauge", "exec/len",
+    ):
+        bad = [
+            v for v in fixture_report.violations if f"'{good}" in v.message
+        ]
+        assert not bad, bad
+
+
+# ------------------------------------------------------------ R3 fixtures ---
+def test_r3_fires_all_three_ways(fixture_report):
+    assert _find(
+        fixture_report, "R3", "r3_sites.py",
+        "site 'not/a_site' is not in resilience/faults.SITES",
+    )
+    assert _find(
+        fixture_report, "R3", "resilience/faults.py",
+        "SITES entry 'ghost/site' has no inject() call site",
+    )
+    assert _find(
+        fixture_report, "R3", "docs/RESILIENCE.md",
+        "fault site 'ghost/site' is undocumented",
+    )
+
+
+# ------------------------------------------------------------ R4 fixtures ---
+def test_r4_fires_per_impurity_class(fixture_report):
+    for marker in (
+        "time.perf_counter()",
+        "print()",
+        "np.random.rand()",
+        "REGISTRY.incr() emission",
+        "os.environ.get() read",
+    ):
+        assert _find(fixture_report, "R4", "r4_trace.py", marker), marker
+
+
+def test_r4_host_side_code_not_flagged(fixture_report):
+    lines = {
+        v.line for v in fixture_report.violations if v.file == "r4_trace.py"
+    }
+    # host_side_is_fine's print/time calls sit on the last lines of the
+    # fixture; no R4 violation may anchor there.
+    text = (FIXTURE_PKG / "r4_trace.py").read_text(encoding="utf-8")
+    start = text.splitlines().index("def host_side_is_fine(x):") + 1
+    assert not {ln for ln in lines if ln >= start}
+
+
+# ------------------------------------------------------------ R5 fixtures ---
+def test_r5_pragma_suppression_honored(fixture_report):
+    via_pragma = [
+        s for s in fixture_report.suppressed
+        if s["via"] == "pragma" and s["file"] == "r5_pragmas.py"
+    ]
+    assert len(via_pragma) == 2  # same-line and pragma-above forms
+    suppressed_lines = {s["line"] for s in via_pragma}
+    leaked = [
+        v for v in fixture_report.violations
+        if v.file == "r5_pragmas.py" and v.rule == "R1"
+        and v.line in suppressed_lines
+    ]
+    assert not leaked
+
+
+def test_r5_stale_pragma_fires(fixture_report):
+    assert _find(
+        fixture_report, "R5", "r5_pragmas.py", "stale suppression pragma"
+    )
+
+
+def test_r5_unknown_rule_id_fires_and_does_not_suppress(fixture_report):
+    assert _find(fixture_report, "R5", "r5_pragmas.py", "unknown rule id")
+    # the R1 under the bogus pragma still stands
+    assert [
+        v for v in fixture_report.violations
+        if v.file == "r5_pragmas.py" and v.rule == "R1"
+    ]
+
+
+def test_r5_allowlist_suppression_and_staleness():
+    live = Allow(
+        "R1", "r1_env.py", "LANGDETECT_ALPHA", "fixture: live entry"
+    )
+    stale = Allow(
+        "R1", "no_such_file.py", "never matches", "fixture: stale entry"
+    )
+    report = run_checks(
+        package_dir=FIXTURE_PKG, repo_root=FIXTURE_ROOT,
+        allowlist=(live, stale),
+    )
+    assert any(
+        s["via"] == "allowlist" and s["reason"] == live.reason
+        for s in report.suppressed
+    )
+    assert _find(report, "R5", "analysis/allowlist.py", "stale allowlist")
+    assert not _find(report, "R1", "r1_env.py", "LANGDETECT_ALPHA")
+
+
+def test_r5_allowlist_suppression_is_bounded():
+    """An entry absorbs at most ``count`` matches (default 1): a SECOND
+    read matching the documented exception's pattern is a new regression
+    and must surface, not ride the allowlist."""
+    broad = Allow("R1", "r1_env.py", "direct env read", "fixture: broad")
+    report = run_checks(
+        package_dir=FIXTURE_PKG, repo_root=FIXTURE_ROOT, allowlist=(broad,)
+    )
+    suppressed = [
+        s for s in report.suppressed
+        if s["via"] == "allowlist" and s["file"] == "r1_env.py"
+    ]
+    assert len(suppressed) == 1  # not every matching read
+    assert _find(report, "R1", "r1_env.py", "direct env read")  # rest stand
+    # raising count widens the budget, and a live entry is not stale
+    wide = Allow(
+        "R1", "r1_env.py", "direct env read", "fixture: wide", count=2
+    )
+    report2 = run_checks(
+        package_dir=FIXTURE_PKG, repo_root=FIXTURE_ROOT, allowlist=(wide,)
+    )
+    assert len([
+        s for s in report2.suppressed if s["via"] == "allowlist"
+    ]) == 2
+    assert not _find(report2, "R5", "analysis/allowlist.py", "stale")
+
+
+# -------------------------------------------------------------- JSON + CLI --
+def test_json_schema_pinned(fixture_report):
+    doc = fixture_report.to_json()
+    assert set(doc) == {
+        "schema", "package", "ok", "total", "counts", "violations",
+        "suppressed",
+    }
+    assert doc["schema"] == JSON_SCHEMA_VERSION
+    assert doc["ok"] is False
+    assert doc["total"] == len(doc["violations"]) > 0
+    assert set(doc["counts"]) == set(RULE_IDS)
+    assert sum(doc["counts"].values()) == doc["total"]
+    for v in doc["violations"]:
+        assert set(v) == {"rule", "file", "line", "message", "hint"}
+        assert v["rule"] in RULE_IDS
+        assert isinstance(v["line"], int) and v["line"] >= 1
+    for s in doc["suppressed"]:
+        assert s["via"] in ("pragma", "allowlist")
+        assert s["reason"]
+    json.dumps(doc)  # must be serializable as-is
+
+
+def test_cli_clean_tree_exits_zero_without_jax():
+    """The external-CI contract: ``python -m …analysis.check --json``
+    exits 0 on the shipped tree, emits the pinned schema, and never
+    imports jax (pure AST, cold-CI-host safe)."""
+    code = (
+        "import sys, json\n"
+        "from spark_languagedetector_tpu.analysis.check import main\n"
+        "rc = main(['--json'])\n"
+        "assert 'jax' not in sys.modules, 'checker must not import jax'\n"
+        "sys.exit(rc)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        cwd=REPO, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is True and doc["schema"] == JSON_SCHEMA_VERSION
+
+
+def test_cli_violations_exit_one(capsys):
+    rc = check_main(["--root", str(FIXTURE_ROOT)])
+    # fixture root has no spark_languagedetector_tpu dir -> usage error
+    assert rc == 2
+    rc = check_main(["--no-such-flag"])
+    assert rc == 2
+
+
+def test_cli_root_with_violations(tmp_path, capsys):
+    pkg = tmp_path / "spark_languagedetector_tpu"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        "import os\nX = os.environ.get('LANGDETECT_WHATEVER')\n",
+        encoding="utf-8",
+    )
+    rc = check_main(["--root", str(tmp_path), "--json"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ok"] is False
+    assert any(
+        v["rule"] == "R1" and v["file"] == "bad.py"
+        for v in doc["violations"]
+    )

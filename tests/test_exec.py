@@ -10,6 +10,7 @@ offline tuner whose profile the runner/stream/serve load at startup.
 """
 
 import json
+import os
 import threading
 
 import numpy as np
@@ -325,6 +326,29 @@ def test_config_precedence_and_type_validation(monkeypatch, tmp_path):
         exec_config.resolve("batch_bytes")
     with pytest.raises(ValueError, match="unknown config knob"):
         exec_config.resolve("no_such_knob")
+
+
+def test_bad_loglevel_does_not_break_package_import():
+    """The pre-config bootstrap read of LANGDETECT_TPU_LOGLEVEL tolerates
+    a bad value (default + warning) instead of raising at import time —
+    a typo'd level must not make the whole package unimportable, matching
+    the tolerance of the post-config re-sync (sync_level_from_config)."""
+    import subprocess
+    import sys
+
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import logging\n"
+        "import spark_languagedetector_tpu.utils.logging as L\n"
+        "assert L._root.level == logging.WARNING\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        env={**os.environ, "LANGDETECT_TPU_LOGLEVEL": "verbose"},
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "LANGDETECT_TPU_LOGLEVEL ignored" in proc.stderr
 
 
 def test_config_int_tuple_and_bool_parsing(monkeypatch):

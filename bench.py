@@ -259,6 +259,103 @@ def make_mixed_corpus(lang_a, lang_b, n_docs, mean_len=400, frac_a=0.7, seed=11)
     return docs
 
 
+def make_codeswitch_corpus(
+    langs, n_docs, block_bytes=1280, blocks=(2, 3), seed=23
+):
+    """Block-structured code-switched docs with KNOWN span boundaries —
+    the segmentation bench corpus (docs/SEGMENTATION.md). Each document
+    concatenates 2-3 single-language blocks of ~``block_bytes`` bytes
+    (adjacent blocks always differ in language), and the ground truth is
+    returned as byte-offset spans ``[(start, end, lang), ...]`` exactly
+    partitioning the document. Unlike :func:`make_mixed_corpus` (word-
+    level interleave — no contiguous truth spans exist), this corpus has
+    an objectively correct segmentation to score span F1 against."""
+    rng = np.random.default_rng(seed)
+    words = {l: np.asarray(word_list(l)) for l in langs}
+    probs = {l: _zipf(len(words[l])) for l in langs}
+
+    def block(lang, target):
+        out = []
+        size = -1  # first word adds no separator
+        while size < target:
+            w = str(rng.choice(words[lang], p=probs[lang]))
+            out.append(w)
+            size += len(w.encode("utf-8")) + 1
+        return " ".join(out)
+
+    docs, truth = [], []
+    for i in range(n_docs):
+        n_blocks = int(rng.choice(list(blocks)))
+        seq = []
+        prev = None
+        for _ in range(n_blocks):
+            pick = [l for l in langs if l != prev]
+            lang = str(rng.choice(pick))
+            seq.append(lang)
+            prev = lang
+        parts = [block(l, block_bytes) for l in seq]
+        spans = []
+        pos = 0
+        for lang, part in zip(seq, parts):
+            nb = len(part.encode("utf-8"))
+            # The joining space after a block belongs to that block —
+            # one boundary byte, noise at the F1 level.
+            end = pos + nb + 1
+            spans.append([pos, end, lang])
+            pos = end
+        spans[-1][1] = pos - 1  # no trailing separator on the last block
+        docs.append(" ".join(parts))
+        truth.append([tuple(s) for s in spans])
+    return docs, truth
+
+
+def span_byte_f1(truth_spans, pred_spans, doc_len: int) -> dict:
+    """Byte-level segmentation quality of ONE document: per-language
+    true/false positives/negatives of the byte labeling the two span
+    lists induce. Aggregate with :func:`macro_span_f1`."""
+    tally: dict = {}
+    t = np.full(doc_len, -1, dtype=np.int64)
+    p = np.full(doc_len, -2, dtype=np.int64)
+    names: list = []
+
+    def idx(lang):
+        if lang not in names:
+            names.append(lang)
+        return names.index(lang)
+
+    for start, end, lang in truth_spans:
+        t[start:end] = idx(lang)
+    for s in pred_spans:
+        p[s["start"]:s["end"]] = idx(s["lang"])
+    for lang in names:
+        i = names.index(lang)
+        tally[lang] = (
+            int(np.sum((t == i) & (p == i))),
+            int(np.sum((t != i) & (p == i))),
+            int(np.sum((t == i) & (p != i))),
+        )
+    return tally
+
+
+def macro_span_f1(tallies) -> float:
+    """Macro-averaged byte F1 over the languages appearing in a corpus'
+    per-document :func:`span_byte_f1` tallies."""
+    agg: dict = {}
+    for tally in tallies:
+        for lang, (tp, fp, fn) in tally.items():
+            a = agg.setdefault(lang, [0, 0, 0])
+            a[0] += tp
+            a[1] += fp
+            a[2] += fn
+    f1s = []
+    for lang, (tp, fp, fn) in agg.items():
+        if tp + fn == 0:
+            continue  # language never in truth: precision-only ghost
+        denom = 2 * tp + fp + fn
+        f1s.append(2 * tp / denom if denom else 0.0)
+    return float(np.mean(f1s)) if f1s else 0.0
+
+
 def add_noise(docs, rate=0.12, seed=17):
     """Typo/byte noise: per word, with probability ``rate``, one random edit
     (replace a char with an ascii letter, delete a char, or swap adjacent
@@ -338,6 +435,52 @@ def accuracy_legs(model, cfg, langs, ref_scorer=None):
         cs90 = make_mixed_corpus(a, b, 300, mean_len=200, frac_a=0.9, seed=18)
         acc(cs90, [a] * len(cs90), "codeswitch90", legs)
         legs["confusable_pair"] = f"{a}/{b}"
+        # codeswitch_seg: the same confusable pair, block-structured with
+        # KNOWN boundaries (make_codeswitch_corpus), measured against the
+        # output mode that can actually express the answer — whole-doc
+        # argmax caps mixed_dominant structurally (a one-label column
+        # cannot be right about a two-language document), while the
+        # segment decode is scored on byte-span F1 and on whether the
+        # top-k candidate set covers every language truly present
+        # (docs/SEGMENTATION.md). Direct decoder call on the model's
+        # existing runner: no param flip, no profile copy, no recompile.
+        from spark_languagedetector_tpu.ops.encoding import texts_to_bytes
+        from spark_languagedetector_tpu.segment import (
+            SegmentOptions,
+            segment_documents,
+        )
+
+        seg_docs, seg_truth = make_codeswitch_corpus([a, b], 60, seed=29)
+        seg_bytes = texts_to_bytes(
+            seg_docs, model.get("predictEncoding")
+        )
+        results = segment_documents(
+            model._get_runner(), seg_bytes, model_langs,
+            options=SegmentOptions(),
+            calibration=getattr(model, "calibration", None),
+        )
+        def clamped_tally(tr, r, d):
+            # Spans partition the SCORED doc (maxScoreBytes truncation
+            # included when a caller left the cap on): score F1 over the
+            # bytes the decoder actually saw.
+            scored = r["spans"][-1]["end"] if r["spans"] else 0
+            scored = min(scored, len(d))
+            tr = [
+                (s, min(e, scored), l) for s, e, l in tr if s < scored
+            ]
+            return span_byte_f1(tr, r["spans"], scored)
+
+        legs["codeswitch_seg_f1"] = round(macro_span_f1(
+            clamped_tally(tr, r, d)
+            for tr, r, d in zip(seg_truth, results, seg_bytes)
+        ), 4)
+        legs["codeswitch_seg_topk_cover"] = round(float(np.mean([
+            all(
+                lang in {e["lang"] for e in r["topk"]}
+                for lang in {s[2] for s in tr}
+            )
+            for tr, r in zip(seg_truth, results)
+        ])), 4)
     return legs
 
 
@@ -2353,6 +2496,308 @@ def smoke_cache(jsonl_path: str | None = None, *, trimmed: bool = False) -> dict
     return result
 
 
+def smoke_segment(jsonl_path: str | None = None, *, trimmed: bool = False) -> dict:
+    """CPU-safe segmentation smoke (docs/SEGMENTATION.md): the span-level
+    code-switch result type through every front end, hard-gated.
+
+    Drives a block-structured synthetic code-switch corpus with KNOWN
+    span boundaries (:func:`make_codeswitch_corpus`) through:
+
+      1. **batch** — ``resultMode="segment"`` transform; byte-span macro
+         F1 against the ground truth must be ≥ 0.85, and top-3 must
+         contain the dominant language of word-level mixed docs ≥ 0.98;
+      2. **calibration** — temperatures fit on a held-out split, ECE
+         measured on a DISJOINT eval split: ≤ 0.10 after the fit and
+         strictly better than uncalibrated (T = 1);
+      3. **stream** — ``run_stream`` over the same corpus in segment
+         mode; the JSON result column must equal the batch transform's
+         exactly (string equality — the decode is deterministic and the
+         JSON canonical);
+      4. **fleet** — 2 replicas behind the router front sharing ONE
+         score cache, concurrent clients mixing segment requests (model
+         defaults AND per-request knob overrides) with label-mode
+         ``/detect`` traffic, and a mid-run two-phase hot-swap to a
+         model fitted on a different corpus and calibrated differently:
+         every response must equal the direct decode/predict of exactly
+         the version that served it — one stale or cross-mode (or
+         cross-knob) cache answer is a mismatch by construction;
+      5. **whole-doc pin** — the runner's ``score`` bytes after all the
+         segment traffic must be bit-identical to the bytes captured
+         before any of it (gather strategy): the new output mode must
+         not perturb the existing one.
+
+    ``trimmed=True`` is the tier-1-sized variant (fewer docs/clients —
+    all five gates still hard); the full run is the CI gate.
+    """
+    import tempfile
+    import threading
+
+    from spark_languagedetector_tpu import LanguageDetector, Table
+    from spark_languagedetector_tpu.ops.encoding import texts_to_bytes
+    from spark_languagedetector_tpu.segment import (
+        SegmentOptions,
+        segment_documents,
+    )
+    from spark_languagedetector_tpu.segment.calibrate import (
+        calibrated_probs,
+        expected_calibration_error,
+        normalize_scores,
+    )
+    from spark_languagedetector_tpu.serve.cache import ScoreCache
+    from spark_languagedetector_tpu.serve.client import ServeClient, ServeHTTPError
+    from spark_languagedetector_tpu.serve.fleet import ServeFleet
+    from spark_languagedetector_tpu.serve.router import RouterServer
+    from spark_languagedetector_tpu.stream.microbatch import memory_source, run_stream
+    from spark_languagedetector_tpu.telemetry import REGISTRY
+    from spark_languagedetector_tpu.telemetry.export import JsonlSink
+
+    REGISTRY.reset()
+    path = jsonl_path or os.path.join(
+        tempfile.gettempdir(), f"segment_smoke_{os.getpid()}.jsonl"
+    )
+    sink = JsonlSink(path)
+    REGISTRY.add_sink(sink)
+    errors: list[str] = []
+
+    # gram_lengths [1,2,3] keep the runners on the gather strategy — the
+    # geometry-stable reference whose whole-doc bytes the pin gate
+    # compares bit-for-bit.
+    langs = ["en", "de", "fr"]
+    docs_a, labels_a = make_corpus(langs, 60, mean_len=300, seed=3)
+    model_a = LanguageDetector(langs, [1, 2, 3], 200).fit(
+        Table({"lang": labels_a, "fulltext": docs_a})
+    )
+    docs_b, labels_b = make_corpus(langs, 60, mean_len=300, seed=9)
+    model_b = LanguageDetector(langs, [1, 2, 3], 150).fit(
+        Table({"lang": labels_b, "fulltext": docs_b})
+    )
+    runner_a = model_a._get_runner()
+
+    # Whole-doc pin capture: BEFORE any segment-mode work touches the
+    # process.
+    pin_docs = texts_to_bytes(docs_a[:24] + ["", "köln 京都 short"])
+    scores_pre = runner_a.score(pin_docs)
+
+    # --- leg 2: calibration (fit split vs disjoint eval split) -------------
+    n_heldout = 60 if trimmed else 150
+    hd, hl = make_corpus(langs, 2 * n_heldout, mean_len=250, seed=77)
+    fit_docs, fit_labels = hd[:n_heldout], hl[:n_heldout]
+    eval_docs, eval_labels = hd[n_heldout:], hl[n_heldout:]
+    model_a.calibrate(Table({"fulltext": fit_docs, "lang": fit_labels}))
+    model_b.calibrate(Table({"fulltext": hd, "lang": hl}))  # different temps
+    eval_bytes = texts_to_bytes(eval_docs)
+    norm = normalize_scores(
+        np.asarray(runner_a.score(eval_bytes), dtype=np.float64),
+        [len(d) for d in eval_bytes],
+    )
+    y = np.asarray([langs.index(l) for l in eval_labels])
+    ece_uncal = expected_calibration_error(
+        calibrated_probs(norm, np.ones(len(langs))), y
+    )
+    ece_cal = expected_calibration_error(
+        calibrated_probs(norm, model_a.calibration.temperatures), y
+    )
+    if ece_cal > 0.10:
+        errors.append(f"calibrated ECE {ece_cal:.4f} > 0.10")
+    if not ece_cal < ece_uncal:
+        errors.append(
+            f"calibration not strictly better: {ece_cal:.4f} vs "
+            f"uncalibrated {ece_uncal:.4f}"
+        )
+
+    # --- leg 1: batch span F1 + top-k ---------------------------------------
+    n_seg = 20 if trimmed else 80
+    seg_docs, seg_truth = make_codeswitch_corpus(langs, n_seg, seed=23)
+    model_seg = model_a.copy().set_result_mode("segment")
+    model_seg.calibration = model_a.calibration
+    batch_out = model_seg.transform(Table({"fulltext": seg_docs}))
+    batch_json = batch_out.column(model_seg.get_output_col()).tolist()
+    batch_results = [json.loads(s) for s in batch_json]
+    seg_bytes = texts_to_bytes(seg_docs)
+    f1 = macro_span_f1(
+        span_byte_f1(tr, r["spans"], len(d))
+        for tr, r, d in zip(seg_truth, batch_results, seg_bytes)
+    )
+    if f1 < 0.85:
+        errors.append(f"segmentation span F1 {f1:.4f} < 0.85")
+
+    n_mixed = 40 if trimmed else 200
+    mixed = make_mixed_corpus("en", "de", n_mixed, mean_len=400,
+                              frac_a=0.7, seed=11)
+    mixed_res = model_seg.segment(mixed)
+    topk_hit = float(np.mean([
+        "en" in {e["lang"] for e in r["topk"]} for r in mixed_res
+    ]))
+    if topk_hit < 0.98:
+        errors.append(f"top-3 true-label hit {topk_hit:.4f} < 0.98 on "
+                      "mixed docs")
+
+    # --- leg 3: stream parity ----------------------------------------------
+    stream_rows = [{"fulltext": t} for t in seg_docs]
+    got_tables: list = []
+    query = run_stream(
+        model_seg, memory_source(stream_rows, 8), got_tables.append
+    )
+    stream_json = [
+        v for tbl in got_tables
+        for v in tbl.column(model_seg.get_output_col()).tolist()
+    ]
+    if stream_json != batch_json:
+        errors.append("stream segment results differ from batch transform")
+    if query.batches != -(-len(stream_rows) // 8):
+        errors.append("stream did not sink every batch")
+
+    # --- leg 4: fleet + shared cache + mid-run hot-swap ---------------------
+    model_b_seg = model_b.copy().set_result_mode("segment")
+    model_b_seg.calibration = model_b.calibration
+    model_b_seg._get_runner().score(seg_bytes[:2])  # warm off the clock
+    shared_cache = ScoreCache()
+    opts_default = SegmentOptions()
+    opts_k1 = SegmentOptions(top_k=1)
+
+    n_clients = 2 if trimmed else 4
+    rounds = 6 if trimmed else 12
+    swap_round = rounds // 2
+    v_old, v_new = "v1", [None]
+    barrier = threading.Barrier(n_clients)
+    lock = threading.Lock()
+    responses: list[tuple] = []
+
+    fleet = ServeFleet(
+        [model_seg] * 2,
+        router_kw=dict(probe_interval_ms=40.0, probe_timeout_s=2.0),
+        max_wait_ms=4, max_rows=64, max_queue_rows=512,
+        cache=shared_cache,
+    ).start()
+    front = RouterServer(fleet.router, fleet=fleet, port=0).start()
+    host, port = front.address
+    try:
+        def drive(ci: int) -> None:
+            crng = np.random.default_rng(700 + ci)
+            client = ServeClient(host, port)
+            for r in range(rounds):
+                try:
+                    barrier.wait(timeout=60)
+                except threading.BrokenBarrierError:
+                    pass
+                if ci == 0 and r == swap_round:
+                    v_new[0] = fleet.swap(models=[model_b_seg] * 2)
+                    continue
+                picks = crng.choice(len(seg_docs), 3)
+                texts = [seg_docs[int(i)] for i in picks]
+                kind = ("segment", "segment_k1", "label")[r % 3]
+                try:
+                    if kind == "segment":
+                        out, meta = client.segment(texts)
+                    elif kind == "segment_k1":
+                        out, meta = client.segment(texts, top_k=1)
+                    else:
+                        out, meta = client.detect(texts)
+                except (ServeHTTPError, OSError) as e:
+                    with lock:
+                        errors.append(f"fleet client {ci} round {r}: {e}")
+                    continue
+                with lock:
+                    responses.append((kind, texts, out, meta))
+
+        threads = [
+            threading.Thread(target=drive, args=(ci,))
+            for ci in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+    finally:
+        front.stop()
+        fleet.close()
+
+    # Zero-staleness / zero-cross-mode gate: every response must equal
+    # the direct decode of exactly the version that served it. The two
+    # versions are fitted on different corpora AND calibrated on
+    # different held-out sets, so a cached pre-swap entry served
+    # post-swap (or a k=1 entry served for a k=3 request, or a label id
+    # for a segment request) cannot match.
+    def direct(version, kind, texts):
+        model = model_seg if version == v_old else model_b_seg
+        byte_docs = texts_to_bytes(texts)
+        if kind == "label":
+            # Segment-mode models answer /detect in their segment
+            # default (docs/SERVING.md §11).
+            return segment_documents(
+                model._get_runner(), byte_docs, langs,
+                options=opts_default, calibration=model.calibration,
+            )
+        return segment_documents(
+            model._get_runner(), byte_docs, langs,
+            options=opts_k1 if kind == "segment_k1" else opts_default,
+            calibration=model.calibration,
+        )
+
+    stale = 0
+    versions_served: set[str] = set()
+    kinds_served: set[str] = set()
+    for kind, texts, out, meta in responses:
+        versions_served.add(meta["version"])
+        kinds_served.add(kind)
+        if out != direct(meta["version"], kind, texts):
+            stale += 1
+    if stale:
+        errors.append(
+            f"{stale}/{len(responses)} stale or cross-mode fleet answers"
+        )
+    if v_new[0] is None or versions_served != {v_old, v_new[0]}:
+        errors.append(f"swap not observed (served {sorted(versions_served)})")
+    if kinds_served != {"segment", "segment_k1", "label"}:
+        errors.append(f"request mix incomplete (served {sorted(kinds_served)})")
+
+    # --- leg 5: whole-doc pin ----------------------------------------------
+    scores_post = runner_a.score(pin_docs)
+    whole_doc_bit_identical = bool(np.array_equal(scores_pre, scores_post))
+    if not whole_doc_bit_identical:
+        errors.append(
+            "whole-doc scores changed after segment traffic (gather)"
+        )
+
+    snap = REGISTRY.snapshot()
+    counters = snap["counters"]
+    seg_docs_n = int(counters.get("segment/docs", 0))
+    result = {
+        "smoke_segment": True,
+        "trimmed": trimmed,
+        "span_f1": round(f1, 4),
+        "topk_hit": round(topk_hit, 4),
+        "calibration": {
+            "ece_uncalibrated": round(ece_uncal, 4),
+            "ece_calibrated": round(ece_cal, 4),
+            "fit_meta": dict(model_a.calibration.meta),
+        },
+        "stream": {
+            "batches": query.batches,
+            "parity": 1.0 if stream_json == batch_json else 0.0,
+        },
+        "fleet": {
+            "replicas": 2,
+            "answered": len(responses),
+            "stale_or_cross_mode": stale,
+            "versions_served": sorted(versions_served),
+            "swap_to": v_new[0],
+            "cache_hits": int(counters.get("cache/hits", 0)),
+        },
+        "segment_counters": {
+            "docs": seg_docs_n,
+            "rejects": int(counters.get("segment/rejects", 0)),
+            "spans": int(counters.get("segment/spans", 0)),
+        },
+        "whole_doc_bit_identical": whole_doc_bit_identical,
+        "errors": errors[:8],
+        "telemetry": telemetry_block(path),
+    }
+    result["ok"] = not errors
+    REGISTRY.remove_sink(sink)
+    return result
+
+
 def fit_scaling_probe(n_devices: int) -> dict:
     """Child half of the fit-scaling leg: run in a subprocess whose
     XLA_FLAGS forced ``n_devices`` virtual CPU devices. Fits the probe
@@ -3223,6 +3668,35 @@ def main():
                     "; ".join(result["errors"])
                     or "gate (parity/staleness/hit-rate/speedup/overhead) "
                     "not met"
+                ),
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        return
+    if "--smoke-segment" in sys.argv[1:]:
+        # Segmentation smoke: block-structured code-switch corpus with
+        # known boundaries through batch, stream, and a 2-replica fleet
+        # with a mid-run hot-swap. Gates: span F1 >= 0.85, calibrated
+        # ECE <= 0.10 and strictly better than uncalibrated, top-3
+        # true-label hit >= 0.98 on mixed docs, zero stale/cross-mode
+        # cache answers, whole-doc scores bit-identical.
+        args = [a for a in sys.argv[1:] if a != "--smoke-segment"]
+        flags = [a for a in args if a.startswith("-")]
+        if flags or len(args) > 1:
+            print(
+                f"usage: python bench.py --smoke-segment [out.jsonl] "
+                f"(got {args})",
+                file=sys.stderr,
+            )
+            sys.exit(2)
+        result = smoke_segment(args[0] if args else None)
+        print(json.dumps(result), flush=True)
+        if not result["ok"]:
+            print(
+                "segment smoke FAILED: "
+                + (
+                    "; ".join(result["errors"])
+                    or "gate (F1/ECE/top-k/staleness/whole-doc pin) not met"
                 ),
                 file=sys.stderr,
             )

@@ -89,6 +89,13 @@ DEFAULT_HEAVY_BATCH_SIZE = 1024
 # 0.93-0.95× the serial median on configs 1/2/3 — so the default stays 1;
 # the knob remains for other link profiles (e.g. co-located PCIe).
 DISPATCH_WORKERS = 1
+# Default segmentation cell width in bytes (docs/SEGMENTATION.md): one
+# per-cell score vector per `SEGMENT_CELL` window start positions. Must be
+# a multiple of 128 — the fused segment kernel's window block IS the cell
+# (lane tiling), and one rule for every strategy keeps fused/gather parity
+# exact. 256B is fine-grained enough that a code-switch span of a sentence
+# or two is visible, while the [B, C, L] result stays small.
+SEGMENT_CELL = 256
 # Default cap on a single micro-batch's padded bytes (= the `batch_bytes`
 # config knob's built-in default; a tuning profile or LANGDETECT_BATCH_BYTES
 # overrides it per deployment). Once a program has executed, h2d transfers
@@ -1773,3 +1780,417 @@ class BatchRunner:
 
     def predict(self, byte_docs: Sequence[bytes], languages: Sequence[str]) -> list[str]:
         return [languages[i] for i in self.predict_ids(byte_docs)]
+
+    # ---------------------------------------- segmentation (per-cell) path ---
+    def _window_scores_device(self, batch, lengths, window_limit, cell):
+        """Gather-formulation per-cell scorer on the operands' device — the
+        segment mode's exactness oracle, and the dispatch for every
+        non-fused strategy (pallas/hybrid/hist/onehot have no per-cell
+        kernel; their segment requests ride this exact program)."""
+        if self.cuckoo is not None:
+            return score_ops.window_scores_batch_cuckoo(
+                batch,
+                lengths,
+                self.weights,
+                self._cuckoo_entries,
+                seed1=self.cuckoo.seed1,
+                seed2=self.cuckoo.seed2,
+                spec=self.spec,
+                cell=cell,
+                block=min(self.block, 1024),
+                window_limit=window_limit,
+            )
+        return score_ops.window_scores_batch(
+            batch,
+            lengths,
+            self.weights,
+            self.lut,
+            spec=self.spec,
+            cell=cell,
+            block=min(self.block, 1024),
+            window_limit=window_limit,
+        )
+
+    def _segment_dispatch_device(self, batch, lengths, window_limit, cell):
+        """One packed batch → [B, ceil(pad_to/cell), L] raw cell scores.
+        Fused runners use the per-cell megakernel variant (single-device;
+        a mesh keeps the GSPMD gather program — exact either way); every
+        other strategy rides the gather cell program."""
+        faults.inject("score/dispatch")
+        if self.strategy == "fused" and self.mesh is None:
+            interpret, layout, wq, scales, lut_f, _, _ = self._fused_state()
+            return score_fused.segment_batch_fused(
+                batch, lengths, wq, scales, lut_f, window_limit,
+                spec=self.spec, layout=layout, cell=cell,
+                interpret=interpret,
+            )
+        if not getattr(self, "_segment_route_logged", False):
+            self._segment_route_logged = True
+            if self.strategy not in ("gather", "fused"):
+                log_event(
+                    _log, "runner.segment_route", strategy=self.strategy,
+                    route="gather",
+                    reason="per-cell output exists for fused and gather "
+                    "programs only",
+                )
+        return self._window_scores_device(batch, lengths, window_limit, cell)
+
+    def _host_window_scores(self, batch_np, lengths_np, limit_np, cell):
+        """Host-interpret per-cell scoring: the gather cell program on the
+        CPU backend with host-resident tables — the segment ladder's last
+        rung, exact like every other rung."""
+        cpu, w, lut, entries = self._host_state()
+        batch = jax.device_put(batch_np, cpu)
+        lengths = jax.device_put(lengths_np, cpu)
+        window_limit = (
+            None if limit_np is None else jax.device_put(limit_np, cpu)
+        )
+        with jax.default_device(cpu):
+            if self.cuckoo is not None:
+                return score_ops.window_scores_batch_cuckoo(
+                    batch,
+                    lengths,
+                    w,
+                    entries,
+                    seed1=self.cuckoo.seed1,
+                    seed2=self.cuckoo.seed2,
+                    spec=self.spec,
+                    cell=cell,
+                    block=min(self.block, 1024),
+                    window_limit=window_limit,
+                )
+            return score_ops.window_scores_batch(
+                batch,
+                lengths,
+                w,
+                lut,
+                spec=self.spec,
+                cell=cell,
+                block=min(self.block, 1024),
+                window_limit=window_limit,
+            )
+
+    def _segment_degraded(
+        self, batch_docs, batch_limits, pad_to, placement, cell, cause=None
+    ):
+        """The degradation ladder in segment mode — fused → device gather
+        cells → host gather cells, exact at every rung (the gather rungs
+        read the original f32 tables, so degraded segment batches never
+        carry quantization error), same fencing/telemetry story as
+        :meth:`_degraded_scores`."""
+        if all(lim == self.max_chunk for lim in batch_limits):
+            limit_np = None
+        else:
+            limit_np = np.asarray(batch_limits, dtype=np.int32)
+        batch_np, lengths_np = self._pack(batch_docs, pad_to)
+        levels = ["host"]
+        if self.strategy == "fused":
+            # Only the fused strategy has a DIFFERENT device program to
+            # fall back from; every other strategy's segment dispatch is
+            # already the gather cell program.
+            levels.insert(0, "gather")
+        last = cause
+        for level in levels:
+            try:
+                with span(
+                    "score/degraded", rows=len(batch_docs), pad_to=pad_to,
+                    level=level, degraded=True, segment=True,
+                ) as sp:
+                    if level == "gather":
+                        faults.inject("score/dispatch")
+                        batch = jax.device_put(batch_np, placement)
+                        lengths = jax.device_put(lengths_np, placement)
+                        window_limit = (
+                            None
+                            if limit_np is None
+                            else jax.device_put(limit_np, placement)
+                        )
+                        cells = self._window_scores_device(
+                            batch, lengths, window_limit, cell
+                        )
+                    else:
+                        cells = self._host_window_scores(
+                            batch_np, lengths_np, limit_np, cell
+                        )
+                    jax.block_until_ready(cells)
+                    sp.fence(cells)
+            except Exception as e:
+                if not self.retry_policy.classify(e):
+                    raise
+                last = e
+                continue
+            self._degraded_mode = True
+            self.metrics.incr("degraded_batches")
+            REGISTRY.incr("resilience/degraded_batches")
+            REGISTRY.incr(f"resilience/degraded_{level}")
+            REGISTRY.set_gauge("langdetect_degraded", 1.0)
+            log_event(
+                _log,
+                "runner.degraded",
+                level=level,
+                rows=len(batch_docs),
+                pad_to=pad_to,
+                segment=True,
+                breaker=self.breaker.state,
+                cause=repr(cause) if cause is not None else None,
+            )
+            return cells
+        raise last if last is not None else RuntimeError(
+            "segment degraded ladder exhausted with no recorded cause"
+        )
+
+    def segment_cells(
+        self, byte_docs: Sequence[bytes], *, cell: int | None = None
+    ) -> tuple[list[np.ndarray], list[bytes]]:
+        """Raw per-cell scores for span-level decoding (docs/SEGMENTATION.md).
+
+        Returns ``(cells, scored_docs)``: ``cells[i]`` is float32
+        ``[C_i, L]`` with ``C_i = max(1, ceil(len_i / cell))`` — entry
+        ``[c]`` sums every window (every gram length) whose start byte
+        lies in ``[c·cell, (c+1)·cell)`` of the document — and
+        ``scored_docs[i]`` is the byte string the cells describe (the
+        input after ``max_score_bytes`` truncation), so the host span
+        decoder snaps boundaries on the content that was actually scored.
+
+        Long documents chunk on a CELL-ALIGNED stride (the whole-doc
+        path's overlap rule rounded so chunk ownership boundaries land on
+        cell boundaries), so every global cell is owned by exactly one
+        chunk and the assembled cells are exact — no cross-chunk blending.
+        Transient dispatch failures replay under the retry policy and
+        ride the degradation ladder (fused → device gather cells → host),
+        exact at every rung. The whole-doc ``score``/``predict_ids``
+        paths share none of this method's dispatch programs and stay
+        bit-identical to their pre-segmentation behavior.
+        """
+        cell = int(cell or SEGMENT_CELL)
+        if cell < 128 or cell % 128:
+            raise ValueError(
+                f"segment cell must be a positive multiple of 128, got {cell}"
+            )
+        if cell > self.max_chunk:
+            raise ValueError(
+                f"segment cell {cell} exceeds the largest length bucket "
+                f"{self.max_chunk}"
+            )
+        try:
+            return self._segment_traced(byte_docs, cell)
+        except Exception as e:
+            flightrec.record_crash("segment", e)
+            raise
+
+    def _segment_traced(self, byte_docs, cell):
+        if self.max_score_bytes:
+            cap = self.max_score_bytes
+            if self.score_encoding == UTF8:
+                byte_docs = [truncate_utf8(d, cap) for d in byte_docs]
+            else:
+                byte_docs = [d[:cap] for d in byte_docs]
+        else:
+            byte_docs = list(byte_docs)
+        N_in = len(byte_docs)
+        inverse = None
+        if self.dedup and N_in > 1:
+            d = dedup_counted(byte_docs)
+            if d is not None:
+                first_idx, inverse, _ = d
+                byte_docs = [byte_docs[int(i)] for i in first_idx]
+        L = self.weights.shape[1]
+        out: list[np.ndarray | None] = [None] * len(byte_docs)
+        if not byte_docs:
+            return [], []
+
+        overlap = max(self.spec.gram_lengths) - 1
+        # Cell-aligned chunk stride: ownership boundaries must land on
+        # cell edges so each global cell belongs to exactly one chunk.
+        stride = ((self.max_chunk - overlap) // cell) * cell
+        if self.mesh is not None:
+            from ..parallel.mesh import batch_sharding, pad_rows_for_mesh
+
+            placement = batch_sharding(self.mesh)
+        else:
+            placement = self.device
+
+        # Work items: (doc index, chunk bytes, window limit, global cell
+        # offset, owned cell count).
+        doc_idx: list[int] = []
+        chunks: list[bytes] = []
+        limits: list[int] = []
+        cell_offs: list[int] = []
+        takes: list[int] = []
+        for i, doc in enumerate(byte_docs):
+            n_cells = max(1, -(-len(doc) // cell))
+            out[i] = np.zeros((n_cells, L), dtype=np.float32)
+            if len(doc) <= self.max_chunk:
+                doc_idx.append(i)
+                chunks.append(doc)
+                limits.append(self.max_chunk)
+                cell_offs.append(0)
+                takes.append(n_cells)
+            else:
+                if stride < cell:
+                    # Only a document that actually needs chunking needs
+                    # the stride; single-chunk docs segment fine even
+                    # when max_chunk leaves no room for one.
+                    raise ValueError(
+                        f"document of {len(doc)} bytes needs chunking, but "
+                        f"segment cell {cell} leaves no cell-aligned chunk "
+                        f"stride under max_chunk {self.max_chunk} "
+                        f"(overlap {overlap})"
+                    )
+                parts = chunk_document(doc, stride + overlap, overlap)
+                for j, part in enumerate(parts):
+                    doc_idx.append(i)
+                    chunks.append(part)
+                    off = j * stride // cell
+                    cell_offs.append(off)
+                    if j < len(parts) - 1:
+                        limits.append(stride)
+                        takes.append(stride // cell)
+                    else:
+                        limits.append(self.max_chunk)
+                        takes.append(n_cells - off)
+
+        sizes = [len(c) for c in chunks]
+        plan = plan_micro_batches(
+            sizes,
+            length_buckets=self.length_buckets,
+            rows_for=lambda pad_to: rows_under_byte_budget(
+                pad_to, self.batch_bytes, self.batch_size
+            ),
+        )
+        multiproc = self.mesh is not None and jax.process_count() > 1
+
+        def on_retry(attempt_no, delay_s, exc):
+            self.metrics.incr("retries")
+            REGISTRY.incr("score/retries")
+
+        def build_and_dispatch(sel, pad_to):
+            batch_docs = [chunks[k] for k in sel]
+            batch_limits = [limits[k] for k in sel]
+            if self.mesh is not None:
+                batch_docs, batch_limits = pad_rows_for_mesh(
+                    batch_docs, self._ndata, (batch_limits, self.max_chunk)
+                )
+            if all(lim == self.max_chunk for lim in batch_limits):
+                limit_np = None
+            else:
+                limit_np = np.asarray(batch_limits, dtype=np.int32)
+            with span("score/pack", parent=seg_span,
+                      rows=len(batch_docs), pad_to=pad_to):
+                batch_np, lengths_np = self._pack(batch_docs, pad_to)
+            with span("score/dispatch", parent=seg_span,
+                      rows=len(batch_docs), pad_to=pad_to) as sp:
+                batch = jax.device_put(batch_np, placement)
+                lengths = jax.device_put(lengths_np, placement)
+                window_limit = (
+                    None if limit_np is None
+                    else jax.device_put(limit_np, placement)
+                )
+                cells = self._segment_dispatch_device(
+                    batch, lengths, window_limit, cell
+                )
+                sp.fence(cells)
+            return cells
+
+        def degraded_for(sel, pad_to, cause):
+            batch_docs = [chunks[k] for k in sel]
+            batch_limits = [limits[k] for k in sel]
+            if self.mesh is not None:
+                batch_docs, batch_limits = pad_rows_for_mesh(
+                    batch_docs, self._ndata, (batch_limits, self.max_chunk)
+                )
+            return self._segment_degraded(
+                batch_docs, batch_limits, pad_to, placement, cell, cause
+            )
+
+        def run_one(item):
+            sel, pad_to = item
+            fallback_ok = not multiproc and self.degraded_fallback
+            cells = guarded_dispatch(
+                lambda: build_and_dispatch(sel, pad_to),
+                policy=self.retry_policy,
+                site="score/dispatch",
+                breaker=self.breaker if fallback_ok else None,
+                degraded=(
+                    (lambda cause: degraded_for(sel, pad_to, cause))
+                    if fallback_ok else None
+                ),
+                on_retry=on_retry,
+                log_fields={"rows": len(sel), "segment": True},
+            )
+            return (sel, pad_to, cells)
+
+        workers = self.dispatch_workers
+        if workers is None:
+            workers = DISPATCH_WORKERS if self.mesh is None else 1
+        workers = max(1, min(workers, len(plan)))
+        with trace_request(), self.metrics.timer("score_s"), span(
+            "score", docs=N_in, unique=len(byte_docs), batches=len(plan),
+            strategy=self.strategy, segment=True, cell=cell,
+        ) as seg_span:
+            pending = run_ordered(plan, run_one, workers)
+            with span("score/fetch", batches=len(plan)):
+                # Start every batch's d2h copy before draining any — the
+                # same prefetch the whole-doc fetch loop does, and worth
+                # strictly more here: segment payloads are [B, C, L]
+                # floats, C cells per chunk wider than the whole-doc
+                # [B, L] rows. Multi-process meshes skip it (results
+                # assemble via process_allgather in _fetch; a host copy
+                # of non-addressable shards can't start).
+                for _, _, c in (pending if not multiproc else ()):
+                    try:
+                        c.copy_to_host_async()
+                    except (AttributeError, *RETRYABLE):
+                        # AttributeError: non-jax array (numpy test
+                        # doubles). Runtime errors: a deferred execution
+                        # error surfacing early — the fetch loop below
+                        # retries it.
+                        pass
+                for sel, pad_to, cells in pending:
+                    try:
+                        faults.inject("score/fetch")
+                        host = self._fetch(cells)
+                    except Exception as e:
+                        # Async dispatch defers execution errors to the
+                        # fetch: replay the batch under the policy, then
+                        # the ladder — never on a multi-process mesh,
+                        # where a lone replay would desynchronize the
+                        # collective schedule.
+                        if multiproc or not self.retry_policy.classify(e):
+                            raise
+                        try:
+                            host = self.retry_policy.run(
+                                lambda sel=sel, pad_to=pad_to: self._fetch(
+                                    build_and_dispatch(sel, pad_to)
+                                ),
+                                site="score/fetch",
+                                breaker=self.breaker,
+                                on_retry=on_retry,
+                                initial_error=e,
+                                log_fields={"rows": len(sel)},
+                            )
+                        except Exception as e2:
+                            if (
+                                not self.degraded_fallback
+                                or not self.retry_policy.classify(e2)
+                            ):
+                                raise
+                            host = self._fetch(degraded_for(
+                                sel, pad_to, e2
+                            ))
+                    for r, k in enumerate(sel):
+                        i = doc_idx[k]
+                        off, take = cell_offs[k], takes[k]
+                        out[i][off:off + take] = host[r, :take]
+
+        self.metrics.incr("docs_scored", N_in)
+        log_event(
+            _log, "runner.segment", docs=N_in, unique=len(byte_docs),
+            chunks=len(chunks), batches=len(plan), cell=cell,
+        )
+        if inverse is not None:
+            return (
+                [out[int(j)] for j in inverse],
+                [byte_docs[int(j)] for j in inverse],
+            )
+        return list(out), list(byte_docs)

@@ -54,7 +54,43 @@ def _positive_int(v) -> bool:
     return isinstance(v, int) and v > 0
 
 
-class _DetectorParams(HasInputCol, HasLabelCol):
+RESULT_MODES = ("label", "segment")
+
+
+def _valid_reject_threshold(v) -> bool:
+    return isinstance(v, (int, float)) and 0.0 <= float(v) < 1.0
+
+
+class _ResultModeParams:
+    """Segmentation result-type params, shared by estimator and model
+    (docs/SEGMENTATION.md): the estimator stamps them onto the fitted
+    model like ``backend``/``quantization``, Spark-style."""
+
+    result_mode = Param(
+        "resultMode",
+        "transform output type: 'label' (the reference's one-language "
+        "argmax column) or 'segment' (span-level code-switch decode — the "
+        "output column carries one JSON object per document with byte-"
+        "offset spans, calibrated top-k languages, and the unknown "
+        "reject; docs/SEGMENTATION.md)",
+        lambda v: v in RESULT_MODES,
+    )
+    top_k = Param(
+        "topK",
+        "segment mode: candidate languages returned per document with "
+        "calibrated probabilities",
+        _positive_int,
+    )
+    reject_threshold = Param(
+        "rejectThreshold",
+        "segment mode: calibrated-probability floor in [0, 1) below which "
+        "a document (or span) answers 'unknown' instead of a low-"
+        "confidence language; 0 disables the reject",
+        _valid_reject_threshold,
+    )
+
+
+class _DetectorParams(HasInputCol, HasLabelCol, _ResultModeParams):
     """Params shared by the estimator (model adds output col instead)."""
 
     supported_languages = Param(
@@ -205,6 +241,15 @@ class LanguageDetector(_DetectorParams):
     def set_weight_mode(self, mode: str):
         return self.set("weightMode", mode)
 
+    def set_result_mode(self, mode: str):
+        return self.set("resultMode", mode)
+
+    def set_top_k(self, k: int):
+        return self.set("topK", k)
+
+    def set_reject_threshold(self, value: float):
+        return self.set("rejectThreshold", value)
+
     # -- contract --------------------------------------------------------------
     def transform_schema(self, schema: Schema) -> Schema:
         """Estimator schema pass-through (LanguageDetector.scala:207)."""
@@ -315,6 +360,9 @@ class LanguageDetector(_DetectorParams):
             model.set("backend", self.get("backend"))
         if self.is_set("quantization"):
             model.set("quantization", self.get("quantization"))
+        for p in ("resultMode", "topK", "rejectThreshold"):
+            if self.is_set(p):
+                model.set(p, self.get(p))
         return model
 
     # -- incremental refit -----------------------------------------------------
@@ -427,10 +475,19 @@ class LanguageDetector(_DetectorParams):
         )
 
 
-class LanguageDetectorModel(HasInputCol, HasOutputCol):
+class LanguageDetectorModel(HasInputCol, HasOutputCol, _ResultModeParams):
     """Model/Transformer: appends the detected-language column.
 
     Reference: ``class LanguageDetectorModel`` (LanguageDetectorModel.scala:178-245).
+
+    ``resultMode="segment"`` switches ``transform``/``detect`` to the
+    span-level code-switch result type (docs/SEGMENTATION.md): the output
+    column carries one JSON object per document — byte-offset spans,
+    calibrated top-k languages, and the unknown reject — decoded by
+    :func:`..segment.segment_documents` over the runner's per-cell device
+    output. ``calibrate(heldout)`` fits the per-language temperatures the
+    calibrated probabilities use; an uncalibrated model segments with
+    T = 1.0 and stamps ``calibrated: false`` on every result.
     """
 
     predict_encoding = Param(
@@ -491,7 +548,14 @@ class LanguageDetectorModel(HasInputCol, HasOutputCol):
             batchSize=None,
             maxScoreBytes=None,
             quantization=None,
+            resultMode="label",
+            topK=3,
+            rejectThreshold=0.0,
         )
+        # Per-language temperature calibration (segment.calibrate) — not a
+        # Param: it is fitted state like the profile, persisted alongside
+        # it, never copied through paramMap metadata.
+        self.calibration = None
         self._runner: BatchRunner | None = None
         # Concurrent transforms (the streaming engine runs >1 transform
         # worker) must not each build a runner: construction uploads device
@@ -528,6 +592,15 @@ class LanguageDetectorModel(HasInputCol, HasOutputCol):
 
     def set_quantization(self, value: str | None):
         return self.set("quantization", value)
+
+    def set_result_mode(self, mode: str):
+        return self.set("resultMode", mode)
+
+    def set_top_k(self, k: int):
+        return self.set("topK", k)
+
+    def set_reject_threshold(self, value: float):
+        return self.set("rejectThreshold", value)
 
     # -- reference accessors ---------------------------------------------------
     @property
@@ -645,8 +718,21 @@ class LanguageDetectorModel(HasInputCol, HasOutputCol):
         out_schema = self.transform_schema(dataset.schema)
         texts = dataset.column(self.get_input_col()).tolist()
         docs = texts_to_bytes(texts, self.get("predictEncoding"))
-        runner = self._get_runner()
-        detected = runner.predict(docs, self.profile.languages)
+        if self.get("resultMode") == "segment":
+            import json
+
+            # Segment mode: the output column carries one canonical JSON
+            # object per document (sort_keys ⇒ byte-stable for identical
+            # results — stream/batch/serve parity is string equality).
+            # Same STRING schema as label mode, so every Table/stream
+            # consumer composes unchanged.
+            detected = [
+                json.dumps(r, sort_keys=True)
+                for r in self.segment_bytes(docs)
+            ]
+        else:
+            runner = self._get_runner()
+            detected = runner.predict(docs, self.profile.languages)
         result = dataset.with_column(self.get_output_col(), detected, STRING)
         if result.schema != out_schema:
             raise RuntimeError(
@@ -655,12 +741,99 @@ class LanguageDetectorModel(HasInputCol, HasOutputCol):
             )
         return result
 
-    def detect(self, text: str) -> str:
+    def detect(self, text: str):
         """Single-document convenience — the reference's static ``detect``
-        (LanguageDetectorModel.scala:131-165) as a method."""
+        (LanguageDetectorModel.scala:131-165) as a method. In
+        ``resultMode="segment"`` this returns the decoded result dict
+        (spans, top-k, reject — docs/SEGMENTATION.md) instead of one
+        label string."""
+        if self.get("resultMode") == "segment":
+            return self.segment([text])[0]
         return self.transform(Table({self.get_input_col(): [text]})).column(
             self.get_output_col()
         )[0]
+
+    # -- segmentation ----------------------------------------------------------
+    def _segment_options(self):
+        from ..segment import SegmentOptions
+
+        return SegmentOptions(
+            top_k=int(self.get("topK")),
+            reject_threshold=float(self.get("rejectThreshold")),
+        )
+
+    def segment(self, texts: Sequence[str]) -> list[dict]:
+        """Span-level code-switch decode for ``texts``
+        (docs/SEGMENTATION.md): one dict per document with byte-offset
+        ``spans``, calibrated ``topk`` candidates, and the ``unknown``
+        reject — regardless of the ``resultMode`` param (``transform``
+        consults the param; this method IS segment mode)."""
+        return self.segment_bytes(
+            texts_to_bytes(list(texts), self.get("predictEncoding"))
+        )
+
+    def segment_bytes(self, byte_docs: Sequence[bytes]) -> list[dict]:
+        from ..segment import segment_documents
+
+        return segment_documents(
+            self._get_runner(),
+            byte_docs,
+            self.profile.languages,
+            options=self._segment_options(),
+            calibration=self.calibration,
+        )
+
+    def calibrate(
+        self, heldout: Table, *, label_col: str = "lang"
+    ) -> "LanguageDetectorModel":
+        """Fit the per-language temperature calibration on a held-out
+        labeled table (``inputCol`` text + ``label_col`` true language) —
+        docs/SEGMENTATION.md §calibration. Deterministic (fixed grids, no
+        RNG); the fitted state lives on ``self.calibration``, persists
+        with the model (``write().save``), and stamps every segment
+        result ``calibrated: true``. Returns ``self``.
+        """
+        from ..segment.calibrate import fit_calibration, normalize_scores
+
+        texts = heldout.column(self.get_input_col()).tolist()
+        labels = heldout.column(label_col).tolist()
+        langs = list(self.profile.languages)
+        lang_idx = {l: i for i, l in enumerate(langs)}
+        unknown = sorted({l for l in labels if l not in lang_idx})
+        if unknown:
+            raise ValueError(
+                f"held-out labels {unknown} not in supportedLanguages"
+            )
+        docs = texts_to_bytes(texts, self.get("predictEncoding"))
+        runner = self._get_runner()
+        scores = runner.score(docs)
+        # Length-normalize by the byte count the runner actually scored
+        # (maxScoreBytes truncation included) — the same transform the
+        # segment decode applies at serve time, or the temperatures would
+        # be fit on a different logit scale than they are used on.
+        cap = runner.max_score_bytes
+        if cap:
+            if runner.score_encoding == UTF8:
+                from ..ops.encoding import truncate_utf8
+
+                lens = [len(truncate_utf8(d, cap)) for d in docs]
+            else:
+                lens = [min(len(d), cap) for d in docs]
+        else:
+            lens = [len(d) for d in docs]
+        self.calibration = fit_calibration(
+            normalize_scores(np.asarray(scores, dtype=np.float64), lens),
+            np.asarray([lang_idx[l] for l in labels], dtype=np.int64),
+            len(langs),
+        )
+        log_event(
+            _log, "model.calibrated", uid=self.uid,
+            heldout_docs=len(docs), **{
+                k: v for k, v in self.calibration.meta.items()
+                if k.startswith(("nll_", "ece_"))
+            },
+        )
+        return self
 
     # -- persistence -----------------------------------------------------------
     def write(self) -> "_ModelWriter":
@@ -676,9 +849,13 @@ class LanguageDetectorModel(HasInputCol, HasOutputCol):
     def load(path: str) -> "LanguageDetectorModel":
         from ..persist.io import load_model
 
-        profile, uid, params = load_model(path)
+        profile, uid, params, calibration = load_model(path)
         model = LanguageDetectorModel(profile, uid=uid)
         model._set_params_from_metadata(params)
+        if calibration is not None:
+            from ..segment.calibrate import Calibration
+
+            model.calibration = Calibration.from_dict(calibration)
         return model
 
 
@@ -715,6 +892,7 @@ class _ModelWriter:
     def save(self, path: str) -> None:
         from ..persist.io import save_model
 
+        calibration = self._model.calibration
         save_model(
             path,
             self._model.profile,
@@ -723,4 +901,7 @@ class _ModelWriter:
             overwrite=self._overwrite,
             layout=self._layout,
             quantize=self._quantize,
+            calibration=(
+                None if calibration is None else calibration.to_dict()
+            ),
         )

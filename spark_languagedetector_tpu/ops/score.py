@@ -403,6 +403,184 @@ def score_batch_onehot(
     return total
 
 
+# ------------------------------------------- per-window (cell) scoring ------
+#
+# The segmentation output mode (docs/SEGMENTATION.md): instead of folding
+# every window's contribution into one [B, L] document score, contributions
+# are kept per CELL — a fixed span of `cell` consecutive window start
+# positions. A window starting at byte s belongs to cell s // cell,
+# regardless of gram length, so the per-cell tensors of all lengths align
+# and sum. Summing a document's cells restores the whole-doc score exactly
+# up to f32 reduction order; the whole-doc paths above are untouched (the
+# bit-identical pre-segmentation contract is pinned by tests/test_segment).
+
+
+def _cell_accumulate(
+    weights: jnp.ndarray,
+    rows: jnp.ndarray,
+    mask: jnp.ndarray,
+    cell: int,
+    n_cells: int,
+    block: int,
+) -> jnp.ndarray:
+    """Σ_w weights[rows[b, w]] · mask[b, w] scattered by window cell →
+    [B, n_cells, L], scanned in window blocks (block rounded to a multiple
+    of ``cell`` so no block straddles a cell boundary)."""
+    B, W = rows.shape
+    L = weights.shape[1]
+    blk = max(cell, (block // cell) * cell)
+    m = blk // cell  # cells per scanned block
+    full = -(-max(W, n_cells * cell) // blk) * blk
+    if full != W:
+        rows = jnp.pad(rows, ((0, 0), (0, full - W)))
+        mask = jnp.pad(mask, ((0, 0), (0, full - W)))
+    nblk = full // blk
+    rows = rows.reshape(B, nblk, m, cell).transpose(1, 0, 2, 3)
+    mask = mask.reshape(B, nblk, m, cell).transpose(1, 0, 2, 3)
+
+    def body(acc, xs):
+        r, mm, k = xs  # [B, m, cell] (+ scalar block index)
+        contrib = weights[r] * mm[..., None].astype(weights.dtype)
+        cells = contrib.sum(axis=2).astype(jnp.float32)  # [B, m, L]
+        cur = jax.lax.dynamic_slice(acc, (0, k * m, 0), (B, m, L))
+        return jax.lax.dynamic_update_slice(
+            acc, cur + cells, (0, k * m, 0)
+        ), None
+
+    init = jnp.zeros((B, nblk * m, L), dtype=jnp.float32)
+    acc, _ = jax.lax.scan(
+        body, init, (rows, mask, jnp.arange(nblk, dtype=jnp.int32))
+    )
+    return acc[:, :n_cells]
+
+
+@partial(jax.jit, static_argnames=("spec", "cell", "block"))
+def window_scores_batch(
+    batch: jnp.ndarray,
+    lengths: jnp.ndarray,
+    weights: jnp.ndarray,
+    lut: jnp.ndarray | None,
+    *,
+    spec: VocabSpec,
+    cell: int,
+    block: int = DEFAULT_BLOCK,
+    window_limit: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Per-cell scores for a padded batch (gather strategies): float32
+    [B, ceil(S / cell), L] where entry ``[b, c]`` sums every window of
+    every gram length whose start position lies in ``[c·cell, (c+1)·cell)``
+    (masking, the Scala ``sliding`` partial-window splice into window 0,
+    and ``window_limit`` chunk ownership all exactly as
+    :func:`score_batch` — the partial window of a short doc lands in cell
+    0). The gather formulation is the segmentation mode's exactness
+    oracle, the same role it plays for whole-doc scoring."""
+    if lut is not None and lut.size == 0:
+        lut = None
+    B, S = batch.shape
+    n_cells = -(-S // cell)
+    miss_row = weights.shape[0] - 1 if lut is not None else 0
+    total = jnp.zeros((B, n_cells, weights.shape[1]), dtype=jnp.float32)
+    for n in spec.gram_lengths:
+        ids = window_ids(batch, n, spec)
+        rows = ids if lut is None else lut[ids]
+        partial_rows = _partial_window_rows(
+            batch, lengths, n, ids[:, 0], spec, lut, miss_row
+        )
+        rows, mask = _splice_partial_windows(
+            rows, partial_rows, lengths, n, window_limit
+        )
+        total = total + _cell_accumulate(
+            weights, rows, mask, cell, n_cells, block
+        )
+    return total
+
+
+@partial(
+    jax.jit,
+    static_argnames=("seed1", "seed2", "spec", "cell", "block"),
+)
+def window_scores_batch_cuckoo(
+    batch: jnp.ndarray,
+    lengths: jnp.ndarray,
+    weights: jnp.ndarray,
+    entries: jnp.ndarray,
+    *,
+    seed1: int,
+    seed2: int,
+    spec: VocabSpec,
+    cell: int,
+    block: int = DEFAULT_BLOCK,
+    window_limit: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """:func:`window_scores_batch` for packed-key cuckoo membership (exact
+    gram lengths 4..5) — the same two-probe row resolution as
+    :func:`score_batch_cuckoo`, scattered per cell."""
+    if spec.mode != EXACT:
+        raise ValueError(
+            "window_scores_batch_cuckoo needs an exact vocab spec — hashed "
+            "specs use integer-id scoring (window_scores_batch)"
+        )
+    B, S = batch.shape
+    n_cells = -(-S // cell)
+    G = weights.shape[0] - 1
+    total = jnp.zeros((B, n_cells, weights.shape[1]), dtype=jnp.float32)
+    for n in spec.gram_lengths:
+        lo, hi = window_keys(batch, n)
+        rows = _cuckoo_rows(lo, hi, entries, G, seed1, seed2)
+        plo, phi = partial_window_keys(batch, lengths, n)
+        prows = _cuckoo_rows(plo, phi, entries, G, seed1, seed2)
+        prows = jnp.where(lengths > 0, prows, G)
+        rows, mask = _splice_partial_windows(
+            rows, prows, lengths, n, window_limit
+        )
+        total = total + _cell_accumulate(
+            weights, rows, mask, cell, n_cells, block
+        )
+    return total
+
+
+def window_scores_numpy(
+    byte_docs: list[bytes],
+    weights: np.ndarray,
+    sorted_ids: np.ndarray | None,
+    spec: VocabSpec,
+    cell: int,
+) -> list[np.ndarray]:
+    """Host mirror of :func:`window_scores_batch` (float64 test oracle):
+    per document a ``[max(1, ceil(len / cell)), L]`` array; window start →
+    cell ``start // cell``; a short doc's partial windows land in cell 0."""
+    from .vocab import short_doc_ids_numpy, window_ids_numpy
+
+    L = weights.shape[1]
+
+    def row_of(ids: np.ndarray) -> np.ndarray:
+        if sorted_ids is None:
+            return weights[ids]
+        if len(sorted_ids) == 0:
+            return np.zeros((len(ids), L), dtype=weights.dtype)
+        pos = np.searchsorted(sorted_ids, ids)
+        pos_c = np.minimum(pos, len(sorted_ids) - 1)
+        hit = sorted_ids[pos_c] == ids
+        rows = np.where(hit, pos_c, weights.shape[0] - 1)
+        return weights[rows]
+
+    out = []
+    for doc in byte_docs:
+        n_cells = max(1, -(-len(doc) // cell))
+        acc = np.zeros((n_cells, L), dtype=np.float64)
+        arr = np.frombuffer(doc, dtype=np.uint8)[None, :]
+        for n in spec.gram_lengths:
+            if len(doc) >= n:
+                ids = window_ids_numpy(arr, n, spec)[0]
+                starts = np.arange(len(ids)) // cell
+                np.add.at(acc, starts, row_of(np.asarray(ids, np.int64)))
+        short = short_doc_ids_numpy(doc, spec)
+        if short:
+            acc[0] += row_of(np.asarray(short, dtype=np.int64)).sum(axis=0)
+        out.append(acc)
+    return out
+
+
 def argmax_language(scores: jnp.ndarray) -> jnp.ndarray:
     """[B, L] → int32 [B]; first maximum wins (reference tie/zero behavior)."""
     return jnp.argmax(scores, axis=1).astype(jnp.int32)

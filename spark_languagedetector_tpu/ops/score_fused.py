@@ -492,6 +492,317 @@ def _build_fused_kernel(
     return kernel
 
 
+# ------------------------------------------------- segment-mode kernel -----
+#
+# The per-window output mode (docs/SEGMENTATION.md): the whole-doc kernel
+# above folds every window block's histogram into ONE per-doc accumulator,
+# throwing the position axis away at the first contraction. The segment
+# variant keeps it at CELL granularity — the window block size is set equal
+# to the cell width, each block's histogram is contracted into its own
+# accumulator column, and the kernel emits [B, C, Lpad] per-cell scores
+# (C = S / cell). Everything else — in-kernel window ids, the FNV folds,
+# the partial-window splice, the streamed table tiles, quantized scales —
+# is identical to the whole-doc kernel, which is untouched (the
+# bit-identical whole-doc contract is pinned by tests/test_segment.py).
+
+
+def _build_fused_segment_kernel(S: int, wseg: int, cell: int,
+                                layout: FusedLayout):
+    """Kernel over grid (doc blocks, table tiles) emitting per-cell scores.
+
+    One histogram scratch per (doc, cell): window block k == cell k, so the
+    [HT, 256] scratch is rebuilt per cell and contracted into the cell's
+    own slice of the [DB, C*Lpad] accumulator. The byte/row planes stay
+    resident across a doc block's tiles exactly like the whole-doc kernel.
+    """
+    HT, T = layout.tile_hi, layout.tiles
+    Lpad, n_langs = layout.lpad, layout.n_langs
+    has_inline = bool(layout.inline)
+    has_rows = bool(layout.rows_lengths)
+    C = S // cell
+
+    def kernel(*refs):
+        it = iter(refs)
+        bytes_ref = next(it) if has_inline else None
+        rows_ref = next(it) if has_rows else None
+        len_ref = next(it)
+        lim_ref = next(it)
+        prow_ref = next(it) if has_inline else None
+        wq_ref = next(it)
+        scale_ref = next(it)
+        out_ref = next(it)
+        hist_ref = next(it)
+        acc_ref = next(it)
+
+        b = pl.program_id(0)
+        t = pl.program_id(1)
+        base = b * DB
+        tile_base = t * HT
+
+        @pl.when(t == 0)
+        def _init():
+            acc_ref[:, :] = jnp.zeros((DB, C * Lpad), jnp.float32)
+
+        for d in range(DB):
+            dlen = len_ref[base + d]
+            dlim = lim_ref[base + d]
+
+            def accumulate(ids, mask):
+                iota_hi = jax.lax.broadcasted_iota(jnp.int32, (HT, cell), 0)
+                iota_lo = jax.lax.broadcasted_iota(jnp.int32, (256, cell), 0)
+                hi_loc = (ids >> 8) - tile_base
+                lo = ids & 255
+                oh_hi = jnp.where(
+                    (hi_loc == iota_hi) & mask, 1.0, 0.0
+                ).astype(jnp.bfloat16)
+                oh_lo = jnp.where(lo == iota_lo, 1.0, 0.0).astype(
+                    jnp.bfloat16
+                )
+                hist_ref[:, :] += jax.lax.dot_general(
+                    oh_hi, oh_lo, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+
+            for k in range(C):
+                off = k * cell
+
+                def cell_step(k=k, off=off):
+                    hist_ref[:, :] = jnp.zeros((HT, 256), jnp.float32)
+                    if has_inline:
+                        for j, (n, kind, p1, p2) in enumerate(layout.inline):
+
+                            def plane(i, off=off):
+                                return bytes_ref[
+                                    pl.dslice(d, 1),
+                                    pl.dslice(i * S + off, cell),
+                                ]
+
+                            if kind == POLY:
+                                ids = jnp.zeros((1, cell), jnp.int32)
+                                for i in range(n):
+                                    ids = ids * 256 + plane(i)
+                                ids = ids + p1
+                            else:
+                                h = jnp.full(
+                                    (1, cell), _FNV_OFFSET_I32, jnp.int32
+                                )
+                                for i in range(n):
+                                    h = (h ^ plane(i)) * _FNV_PRIME_I32
+                                if kind == FNV_MASK:
+                                    ids = h & p1
+                                else:
+                                    # Same exact float-quotient fold as the
+                                    # whole-doc kernel (see its comment).
+                                    hf = h.astype(jnp.float32)
+                                    hf = jnp.where(
+                                        h < 0, hf + jnp.float32(2.0**32), hf
+                                    )
+                                    q = jnp.floor(
+                                        hf / jnp.float32(p2)
+                                    ).astype(jnp.int32)
+                                    r = h - q * p2
+                                    r = jnp.where(r < 0, r + p2, r)
+                                    r = jnp.where(r < 0, r + p2, r)
+                                    r = jnp.where(r >= p2, r - p2, r)
+                                    r = jnp.where(r >= p2, r - p2, r)
+                                    ids = p1 + r
+                            starts = jax.lax.broadcasted_iota(
+                                jnp.int32, (1, cell), 1
+                            ) + off
+                            mask = (starts <= dlen - n) & (starts < dlim)
+                            if k == 0:
+                                short = dlen < n
+                                lane0 = starts == 0
+                                ids = jnp.where(
+                                    lane0 & short, prow_ref[base + d, j], ids
+                                )
+                                mask = mask | (lane0 & short & (dlen > 0))
+                            accumulate(ids, mask)
+                    if has_rows:
+                        for j in range(len(layout.rows_lengths)):
+                            r = rows_ref[
+                                pl.dslice(d, 1),
+                                pl.dslice(j * wseg + off, cell),
+                            ]
+                            # Masked windows are row -1: hi -1 one-hots to
+                            # nothing, no extra mask plane needed.
+                            accumulate(r, jnp.full((1, cell), True))
+
+                    def h_body(h, carry):
+                        hrow = hist_ref[pl.dslice(h, 1), :]
+                        wrow = wq_ref[
+                            pl.dslice(pl.multiple_of(h * 256, 256), 256), :
+                        ].astype(jnp.float32)
+                        acc_ref[
+                            pl.dslice(d, 1), pl.dslice(k * Lpad, Lpad)
+                        ] += jax.lax.dot_general(
+                            hrow, wrow, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                        )
+                        return carry
+
+                    jax.lax.fori_loop(0, HT, h_body, 0)
+
+                # No window of this cell starts inside the doc's owned
+                # range: skip the hash + matmuls entirely.
+                pl.when((off < dlen) & (off < dlim))(cell_step)
+
+        @pl.when(t == T - 1)
+        def _emit():
+            for c in range(C):
+                sl = pl.dslice(c * Lpad, Lpad)
+                out_ref[:, sl] = acc_ref[:, sl] * scale_ref[0:1, :]
+
+    return kernel
+
+
+def _fused_segment_call(
+    batch: jnp.ndarray,
+    lengths: jnp.ndarray,
+    wq: jnp.ndarray,
+    scales: jnp.ndarray,
+    lut: jnp.ndarray | None,
+    window_limit: jnp.ndarray | None,
+    spec: VocabSpec,
+    layout: FusedLayout,
+    cell: int,
+    interpret: bool,
+):
+    if cell < 128 or cell % 128:
+        raise ValueError(
+            f"fused segment cell width must be a positive multiple of 128 "
+            f"(lane tiling), got {cell}"
+        )
+    B0, S0 = batch.shape
+    if layout.rows and wq.shape != (layout.rows_padded, layout.lpad):
+        raise ValueError(
+            f"fused table shape {wq.shape} disagrees with layout "
+            f"({layout.rows_padded}, {layout.lpad})"
+        )
+    # Lane padding: S a whole number of cells (the cell IS the window
+    # block, so no extra block rounding exists in this variant).
+    S = -(-S0 // cell) * cell
+    if S != S0:
+        batch = jnp.pad(batch, ((0, 0), (0, S - S0)))
+    B = -(-B0 // DB) * DB
+    if B != B0:
+        batch = jnp.pad(batch, ((0, B - B0), (0, 0)))
+        lengths = jnp.pad(lengths, (0, B - B0))
+        if window_limit is not None:
+            window_limit = jnp.pad(window_limit, (0, B - B0))
+    lengths = lengths.astype(jnp.int32)
+    lim = (
+        jnp.full((B,), S, dtype=jnp.int32)
+        if window_limit is None
+        else window_limit.astype(jnp.int32)
+    )
+    b32 = batch.astype(jnp.int32)
+
+    has_inline = bool(layout.inline)
+    has_rows = bool(layout.rows_lengths)
+
+    operands = []
+    in_specs = []
+    if has_inline:
+        P = layout.max_inline
+        planes = [
+            jnp.pad(b32[:, i:], ((0, 0), (0, i))) if i else b32
+            for i in range(P)
+        ]
+        operands.append(jnp.concatenate(planes, axis=1))
+        in_specs.append(
+            pl.BlockSpec(
+                (DB, P * S), lambda b, t: (b, 0), memory_space=pltpu.VMEM
+            )
+        )
+    wseg = 0
+    if has_rows:
+        wmax = max(max(S - n + 1, 1) for n in layout.rows_lengths)
+        wseg = -(-wmax // cell) * cell
+        operands.append(
+            _rows_plane(batch, lengths, lut, window_limit, spec, layout, wseg)
+        )
+        KW = wseg * len(layout.rows_lengths)
+        in_specs.append(
+            pl.BlockSpec(
+                (DB, KW), lambda b, t: (b, 0), memory_space=pltpu.VMEM
+            )
+        )
+    operands += [lengths, lim]
+    in_specs += [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+    ]
+    if has_inline:
+        operands.append(_inline_partial_rows(batch, lengths, spec, layout))
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    HT, T, Lpad = layout.tile_hi, layout.tiles, layout.lpad
+    operands.append(wq)
+    in_specs.append(
+        pl.BlockSpec(
+            (HT * 256, Lpad), lambda b, t: (t, 0), memory_space=pltpu.VMEM
+        )
+    )
+    operands.append(scales.astype(jnp.float32))
+    in_specs.append(
+        pl.BlockSpec((8, Lpad), lambda b, t: (0, 0), memory_space=pltpu.VMEM)
+    )
+
+    C = S // cell
+    out = pl.pallas_call(
+        _build_fused_segment_kernel(S, wseg, cell, layout),
+        grid=(B // DB, T),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (DB, C * Lpad), lambda b, t: (b, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, C * Lpad), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((HT, 256), jnp.float32),
+            pltpu.VMEM((DB, C * Lpad), jnp.float32),
+        ],
+        compiler_params=COMPILER_PARAMS(
+            dimension_semantics=("arbitrary", "arbitrary")
+        ),
+        interpret=interpret,
+    )(*operands)
+    return out.reshape(B, C, Lpad)[:B0, :, : layout.n_langs]
+
+
+@partial(
+    jax.jit,
+    static_argnames=("spec", "layout", "cell", "interpret"),
+)
+def segment_batch_fused(
+    batch: jnp.ndarray,
+    lengths: jnp.ndarray,
+    wq: jnp.ndarray,
+    scales: jnp.ndarray,
+    lut: jnp.ndarray | None = None,
+    window_limit: jnp.ndarray | None = None,
+    *,
+    spec: VocabSpec,
+    layout: FusedLayout,
+    cell: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """float32 [B, ceil(S / cell), L] per-cell scores via the fused kernel.
+
+    The segmentation-mode twin of :func:`score_batch_fused`: same window
+    ids, masking, partial-window splice, ``window_limit`` chunk ownership,
+    and quantized scales — but window contributions land in the cell of
+    their start position (``start // cell``) instead of one doc total, so
+    the host span decoder (:mod:`...segment.spans`) can see where each
+    language lives. Summing a row's cells restores the whole-doc score up
+    to f32 reduction order. Exact integer histogram counts × (quantized)
+    weights per cell, like the whole-doc kernel.
+    """
+    return _fused_segment_call(
+        batch, lengths, wq, scales, lut, window_limit,
+        spec, layout, cell, interpret,
+    )
+
+
 # ------------------------------------------------------------- wrapper -----
 
 

@@ -77,8 +77,16 @@ def save_model(
     overwrite: bool = True,
     layout: str = "native",
     quantize: str | None = None,
+    calibration: dict | None = None,
 ) -> None:
     """Write the model directory (SaveMode.Overwrite semantics).
+
+    The write is crash-atomic the same way :func:`save_fit_state` and
+    ``api.pipeline`` saves are: the tree is built under a temp sibling and
+    swapped in with renames, so a process killed mid-save leaves either
+    the previous model or the new one at ``path`` — never a torn tree
+    (segmentation hot-swaps load models this writer produced mid-traffic,
+    docs/SEGMENTATION.md).
 
     ``layout="reference"`` writes the Scala implementation's exact on-disk
     shape — tuple-column probabilities parquet under the JVM class name,
@@ -93,7 +101,20 @@ def save_model(
     quantize∘dequantize, so a model served through the fused quantized
     strategy round-trips to bit-identical quantized scores, at 4x/2x less
     disk than float64 rows. Native layout only.
+
+    ``calibration`` is the segmentation temperature state
+    (``segment.calibrate.Calibration.to_dict()``): one float per language
+    plus the held-out fit provenance, embedded in the metadata JSON so
+    temperatures and profile commit atomically together. JSON ``repr``
+    round-trips doubles exactly, so the loaded temperatures — and
+    therefore the calibration content version the serve cache keys on —
+    are bit-identical to the saved ones. Reference layout has nowhere to
+    put it: the state is dropped with a logged event, and the loaded
+    model serves segmentation uncalibrated with an explicit
+    ``calibrated: false`` flag, never silently wrong.
     """
+    import os
+
     import pyarrow as pa
 
     if layout not in ("native", "reference"):
@@ -117,11 +138,21 @@ def save_model(
                 "format stores float64 rows only"
             )
     root = Path(path)
-    if root.exists():
-        if not overwrite:
-            raise FileExistsError(f"{root} already exists")
-        shutil.rmtree(root)
-    root.mkdir(parents=True)
+    if root.exists() and not overwrite:
+        raise FileExistsError(f"{root} already exists")
+    if calibration is not None and layout == "reference":
+        log_event(
+            _log, "model.calibration_dropped", path=str(root),
+            reason="reference layout has no calibration field; the loaded "
+            "model serves segmentation with calibrated=false provenance",
+        )
+        calibration = None
+    # Build the whole tree under a temp sibling; the swap at the end is
+    # the only destructive step.
+    tmp = root.parent / f".{root.name}.tmp.{os.getpid()}"
+    if tmp.exists():
+        shutil.rmtree(tmp)
+    tmp.mkdir(parents=True)
 
     # metadata/ — single JSON line, Spark DefaultParamsWriter-style fields.
     if layout == "reference":
@@ -156,93 +187,150 @@ def save_model(
             },
             "languages": list(profile.languages),
         }
+        if calibration is not None:
+            if len(calibration.get("temperatures", ())) != len(
+                profile.languages
+            ):
+                raise ValueError(
+                    "calibration covers "
+                    f"{len(calibration.get('temperatures', ()))} languages, "
+                    f"profile has {len(profile.languages)}"
+                )
+            meta["calibration"] = calibration
     # Quantized storage: the integer rows go into probabilities/, the
     # per-language scales (the other half of the codec) into metadata.
     # One compaction pass serves both the quantizer and the bucket/gram
     # columns below (a no-op for already-compact profiles; for the dense
     # hashed form it is a full-table scan worth doing once).
-    compact = profile.compacted()
-    quant_rows = None
-    if quantize is not None:
-        from ..models.profile import quantize_weights
+    try:
+        compact = profile.compacted()
+        quant_rows = None
+        if quantize is not None:
+            from ..models.profile import quantize_weights
 
-        quant_rows, quant_scales = quantize_weights(
-            compact.weights, quantize
-        )
-        meta["quantization"] = {
-            "dtype": quantize,
-            "scales": [float(s) for s in quant_scales],
-        }
-    meta_dir = root / "metadata"
-    meta_dir.mkdir()
-    (meta_dir / "part-00000").write_text(json.dumps(meta) + "\n")
+            quant_rows, quant_scales = quantize_weights(
+                compact.weights, quantize
+            )
+            meta["quantization"] = {
+                "dtype": quantize,
+                "scales": [float(s) for s in quant_scales],
+            }
+        meta_dir = tmp / "metadata"
+        meta_dir.mkdir()
+        (meta_dir / "part-00000").write_text(json.dumps(meta) + "\n")
 
-    # probabilities/ — gram bytes (exact) or bucket ids (hashed) + weights.
-    if layout == "reference":
-        # Spark tuple encoding of Dataset[(Seq[Byte], Array[Double])]:
-        # _1 = list<int8> (JVM bytes are signed), _2 = list<double>.
-        grams = [profile.spec.id_to_gram(int(i)) for i in profile.ids]
-        prob_table = pa.table(
-            {
-                "_1": pa.array(
-                    [
-                        np.frombuffer(g, np.uint8).astype(np.int8).tolist()
-                        for g in grams
-                    ],
-                    type=pa.list_(pa.int8()),
-                ),
-                "_2": pa.array(
-                    [row.tolist() for row in profile.weights],
-                    type=pa.list_(pa.float64()),
-                ),
-            }
-        )
-    elif profile.spec.mode == EXACT:
-        grams = [profile.spec.id_to_gram(int(i)) for i in profile.ids]
-        rows = (
-            quant_rows if quant_rows is not None else profile.weights
-        )
-        value_type = pa.int32() if quant_rows is not None else pa.float64()
-        prob_table = pa.table(
-            {
-                "gram": pa.array(grams, type=pa.binary()),
-                "probabilities": pa.array(
-                    [row.tolist() for row in rows],
-                    type=pa.list_(value_type),
-                ),
-            }
-        )
-    else:
-        rows = quant_rows if quant_rows is not None else compact.weights
-        value_type = pa.int32() if quant_rows is not None else pa.float64()
-        prob_table = pa.table(
-            {
-                "bucket": pa.array(compact.ids.tolist(), type=pa.int64()),
-                "probabilities": pa.array(
-                    [row.tolist() for row in rows],
-                    type=pa.list_(value_type),
-                ),
-            }
-        )
-    _write_parquet(root / "probabilities", prob_table)
+        # probabilities/ — gram bytes (exact) or bucket ids (hashed) + weights.
+        if layout == "reference":
+            # Spark tuple encoding of Dataset[(Seq[Byte], Array[Double])]:
+            # _1 = list<int8> (JVM bytes are signed), _2 = list<double>.
+            grams = [profile.spec.id_to_gram(int(i)) for i in profile.ids]
+            prob_table = pa.table(
+                {
+                    "_1": pa.array(
+                        [
+                            np.frombuffer(g, np.uint8).astype(np.int8).tolist()
+                            for g in grams
+                        ],
+                        type=pa.list_(pa.int8()),
+                    ),
+                    "_2": pa.array(
+                        [row.tolist() for row in profile.weights],
+                        type=pa.list_(pa.float64()),
+                    ),
+                }
+            )
+        elif profile.spec.mode == EXACT:
+            grams = [profile.spec.id_to_gram(int(i)) for i in profile.ids]
+            rows = (
+                quant_rows if quant_rows is not None else profile.weights
+            )
+            value_type = pa.int32() if quant_rows is not None else pa.float64()
+            prob_table = pa.table(
+                {
+                    "gram": pa.array(grams, type=pa.binary()),
+                    "probabilities": pa.array(
+                        [row.tolist() for row in rows],
+                        type=pa.list_(value_type),
+                    ),
+                }
+            )
+        else:
+            rows = quant_rows if quant_rows is not None else compact.weights
+            value_type = pa.int32() if quant_rows is not None else pa.float64()
+            prob_table = pa.table(
+                {
+                    "bucket": pa.array(compact.ids.tolist(), type=pa.int64()),
+                    "probabilities": pa.array(
+                        [row.tolist() for row in rows],
+                        type=pa.list_(value_type),
+                    ),
+                }
+            )
+        _write_parquet(tmp / "probabilities", prob_table)
 
-    # supportedLanguages/ and gramLengths/ — mirroring the reference layout.
-    _write_parquet(
-        root / "supportedLanguages",
-        pa.table({"value": pa.array(list(profile.languages), type=pa.string())}),
+        # supportedLanguages/ and gramLengths/ — mirroring the reference
+        # layout.
+        _write_parquet(
+            tmp / "supportedLanguages",
+            pa.table(
+                {"value": pa.array(list(profile.languages), type=pa.string())}
+            ),
+        )
+        _write_parquet(
+            tmp / "gramLengths",
+            pa.table(
+                {
+                    "value": pa.array(
+                        list(profile.spec.gram_lengths), type=pa.int32()
+                    )
+                }
+            ),
+        )
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+    # The two-rename swap (same protocol as save_fit_state): old root
+    # renamed aside, tmp renamed in, failure restores the old root. A
+    # crash between the renames leaves the complete tree in a sibling —
+    # nothing here ever deletes the only good copy.
+    backup = None
+    if root.exists():
+        backup = root.parent / f".{root.name}.old.{os.getpid()}"
+        if backup.exists():
+            shutil.rmtree(backup)
+        os.replace(root, backup)
+    try:
+        os.replace(tmp, root)
+    except BaseException:
+        if backup is not None:
+            os.replace(backup, root)
+        raise
+    if backup is not None:
+        shutil.rmtree(backup)
+    # A crashed EARLIER save (different pid) may have left .tmp/.old
+    # siblings behind; with a good tree now at root they are garbage —
+    # clean them so crashed saves don't leak model-sized trees
+    # (save_fit_state does the same).
+    for stale in list(root.parent.glob(f".{root.name}.tmp.*")) + list(
+        root.parent.glob(f".{root.name}.old.*")
+    ):
+        shutil.rmtree(stale, ignore_errors=True)
+    log_event(
+        _log, "model.saved", path=str(root), grams=profile.num_grams,
+        calibrated=calibration is not None,
     )
-    _write_parquet(
-        root / "gramLengths",
-        pa.table({"value": pa.array(list(profile.spec.gram_lengths), type=pa.int32())}),
-    )
-    log_event(_log, "model.saved", path=str(root), grams=profile.num_grams)
 
 
-def load_model(path: str | Path) -> tuple[GramProfile, str, dict]:
-    """Read a model directory → (profile, uid, params).
+def load_model(path: str | Path) -> tuple[GramProfile, str, dict, dict | None]:
+    """Read a model directory → (profile, uid, params, calibration).
 
-    Checks the stored class name like the reference reader
-    (LanguageDetectorModel.scala:66,72).
+    ``calibration`` is the segmentation temperature state saved with the
+    model (``Calibration.to_dict()`` shape), or None for models saved
+    without one — the loader never invents a calibration, so an
+    uncalibrated model stays explicitly uncalibrated
+    (docs/SEGMENTATION.md). Checks the stored class name like the
+    reference reader (LanguageDetectorModel.scala:66,72).
     """
     root = Path(path)
     meta_file = root / "metadata" / "part-00000"
@@ -332,7 +420,7 @@ def load_model(path: str | Path) -> tuple[GramProfile, str, dict]:
         # Spark's DefaultParamsWriter stores explicitly-set params as a flat
         # name->value map; our Params metadata nests them under "params".
         params = {"params": params}
-    return profile, meta["uid"], params
+    return profile, meta["uid"], params, meta.get("calibration")
 
 
 _FIT_STATE_CLASS = "spark_languagedetector_tpu.models.refit.FitAccumulator"

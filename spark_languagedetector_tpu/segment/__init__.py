@@ -1,0 +1,20 @@
+"""Span-level code-switch segmentation: the first new result type since
+the seed (docs/SEGMENTATION.md).
+
+Device side (:mod:`..ops.score` / :mod:`..ops.score_fused` /
+:meth:`..api.runner.BatchRunner.segment_cells`) produces raw per-cell
+score tensors; this package is everything after the fetch:
+
+* :mod:`.spans`     — smoothing, per-cell decoding, and the byte-offset
+  span merge (min-span, gap healing, UTF-8 boundary snapping);
+* :mod:`.calibrate` — per-language temperature scaling fit on held-out
+  data, persisted with the model;
+* :mod:`.topk`      — top-k languages with calibrated probabilities and
+  the unknown/low-confidence reject;
+* :mod:`.api`       — :func:`segment_documents`, the orchestrator every
+  front end (estimator, stream, serve) dispatches to.
+"""
+
+from .api import SegmentOptions, segment_documents  # noqa: F401
+from .calibrate import Calibration, fit_calibration  # noqa: F401
+from .topk import UNKNOWN, topk_decode  # noqa: F401

@@ -1,0 +1,188 @@
+"""The segmentation orchestrator: runner cells → structured results.
+
+:func:`segment_documents` is the one decode path every front end
+dispatches to — the estimator's segment mode, ``run_stream`` (via the
+model's ``transform``), and the serve batcher's segment requests — so
+batch/stream/serve answers are identical by construction for identical
+documents and options.
+
+Result shape (one dict per document, JSON-ready — the serve cache stores
+exactly this, serialized):
+
+.. code-block:: python
+
+    {
+      "label": "en" | "unknown",          # top-1, or the reject label
+      "rejected": False,
+      "calibrated": True,                 # explicit provenance — an
+                                          # uncalibrated model says so
+      "topk": [{"lang": "en", "prob": 0.93}, ...],
+      "spans": [{"start": 0, "end": 57, "lang": "en",
+                 "confidence": 0.91}, ...],
+    }
+
+Telemetry (docs/OBSERVABILITY.md §4): counters ``segment/docs`` /
+``segment/rejects`` / ``segment/spans``, histograms
+``segment/spans_per_doc`` / ``segment/span_len_bytes``, and the host
+merge under a ``segment/merge`` span. ``telemetry/compare`` tracks the
+whole-run ``segment/reject_rate`` ratio — a reject rate drifting UP on a
+fixed workload means the confidence pipeline regressed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..telemetry import REGISTRY, span
+from .calibrate import Calibration, calibrated_probs, normalize_scores
+from .spans import decode_cells, merge_spans, smooth_cells
+from .topk import UNKNOWN, topk_decode
+
+
+@dataclass(frozen=True)
+class SegmentOptions:
+    """Every knob of one segmentation decode, hashable and stringable —
+    the serve batcher coalesces on :meth:`key` and the score cache embeds
+    it (plus the calibration version) in the entry key, so two requests
+    with different knobs can never cross-answer (docs/SERVING.md §11)."""
+
+    cell: int = 256              # device cell width (bytes; multiple of 128)
+    smooth: int = 3              # box-smoothing width in cells
+    top_k: int = 3               # languages returned per document
+    reject_threshold: float = 0.0  # calibrated-prob floor; 0 ⇒ never reject
+    min_span_bytes: int = 16     # spans shorter than this heal into neighbors
+
+    def __post_init__(self):
+        if self.cell < 128 or self.cell % 128:
+            raise ValueError(
+                f"cell must be a positive multiple of 128, got {self.cell}"
+            )
+        if self.smooth < 1:
+            raise ValueError(f"smooth must be >= 1, got {self.smooth}")
+        if self.top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {self.top_k}")
+        if not 0.0 <= self.reject_threshold < 1.0:
+            raise ValueError(
+                "reject_threshold must be in [0, 1), got "
+                f"{self.reject_threshold}"
+            )
+        if self.min_span_bytes < 1:
+            raise ValueError(
+                f"min_span_bytes must be >= 1, got {self.min_span_bytes}"
+            )
+
+    def key(self) -> str:
+        """Canonical string of every knob — the batch/cache key component."""
+        return (
+            f"cell={self.cell},smooth={self.smooth},k={self.top_k},"
+            f"reject={self.reject_threshold!r},min={self.min_span_bytes}"
+        )
+
+
+def segment_documents(
+    runner,
+    byte_docs,
+    languages,
+    *,
+    options: SegmentOptions | None = None,
+    calibration: Calibration | None = None,
+) -> list[dict]:
+    """Segment ``byte_docs``: per-window device decode → span merge →
+    calibrated top-k with reject. One result dict per input document (the
+    module docstring shows the shape); input order preserved.
+
+    ``calibration`` None ⇒ the identity calibration (T = 1.0 everywhere)
+    with ``calibrated: false`` stamped on every result — uncalibrated
+    serving is explicit, never silent.
+    """
+    opts = options or SegmentOptions()
+    languages = [str(l) for l in languages]
+    if len(languages) != int(runner.weights.shape[1]):
+        raise ValueError(
+            f"{len(languages)} language names for a "
+            f"{int(runner.weights.shape[1])}-language runner"
+        )
+    calib = calibration or Calibration.identity(len(languages))
+    if calib.temperatures.shape[0] != len(languages):
+        raise ValueError(
+            f"calibration covers {calib.temperatures.shape[0]} languages, "
+            f"model has {len(languages)}"
+        )
+    calibrated = calib.calibrated
+
+    cells_list, scored_docs = runner.segment_cells(byte_docs, cell=opts.cell)
+
+    results: list[dict] = []
+    n_rejects = 0
+    n_spans_total = 0
+    with span("segment/merge", docs=len(cells_list), cell=opts.cell):
+        for cells, doc in zip(cells_list, scored_docs):
+            doc_len = len(doc)
+            smoothed = smooth_cells(cells, opts.smooth)
+            winners, margins = decode_cells(smoothed)
+            spans = merge_spans(
+                winners, margins,
+                cell=opts.cell, doc_len=doc_len, doc=doc,
+                min_span_bytes=opts.min_span_bytes,
+            )
+            # Document-level calibrated distribution from the exact cell
+            # sums (length-normalized — the calibration's logit form).
+            doc_vec = normalize_scores(
+                cells.sum(axis=0, dtype=np.float64)[None, :], [doc_len]
+            )
+            doc_probs = calibrated_probs(doc_vec, calib.temperatures)[0]
+            topk, label, rejected = topk_decode(
+                doc_probs, languages, opts.top_k, opts.reject_threshold
+            )
+
+            out_spans = []
+            for s in spans:
+                span_vec = normalize_scores(
+                    cells[s.start // opts.cell:
+                          -(-s.end // opts.cell)].sum(
+                        axis=0, dtype=np.float64
+                    )[None, :],
+                    [s.end - s.start],
+                )
+                span_probs = calibrated_probs(
+                    span_vec, calib.temperatures
+                )[0]
+                conf = float(span_probs[s.lang_id])
+                out_spans.append({
+                    "start": int(s.start),
+                    "end": int(s.end),
+                    # The span-level reject: a span whose own calibrated
+                    # confidence sits below the threshold reports unknown
+                    # rather than a coin-flip language.
+                    "lang": (
+                        UNKNOWN if conf < opts.reject_threshold
+                        else languages[s.lang_id]
+                    ),
+                    "confidence": round(conf, 6),
+                })
+                REGISTRY.observe(
+                    "segment/span_len_bytes", float(s.end - s.start)
+                )
+            results.append({
+                "label": label,
+                "rejected": rejected,
+                "calibrated": calibrated,
+                "topk": [
+                    {"lang": e["lang"], "prob": round(e["prob"], 6)}
+                    for e in topk
+                ],
+                "spans": out_spans,
+            })
+            n_rejects += int(rejected)
+            n_spans_total += len(out_spans)
+            REGISTRY.observe("segment/spans_per_doc", float(len(out_spans)))
+    # Unconditional (0 included): the compare guard derives the tracked
+    # ``segment/reject_rate`` ratio from these counters, and a zero-reject
+    # baseline must still carry the denominator AND a zero numerator so a
+    # candidate that starts rejecting regresses against it.
+    REGISTRY.incr("segment/docs", len(results))
+    REGISTRY.incr("segment/rejects", n_rejects)
+    REGISTRY.incr("segment/spans", n_spans_total)
+    return results

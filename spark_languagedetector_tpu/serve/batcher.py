@@ -112,7 +112,8 @@ class ServeResult:
     """One request's demuxed response.
 
     ``values`` is the request's contiguous slice of the coalesced result:
-    float32 ``[N, L]`` scores, or int32 ``[N]`` argmax ids in label mode.
+    float32 ``[N, L]`` scores, int32 ``[N]`` argmax ids in label mode, or
+    a list of N result dicts in segment mode (docs/SEGMENTATION.md).
     """
 
     values: np.ndarray
@@ -132,6 +133,11 @@ class ServeResult:
             raise ServeError("serving source carries no language names")
         return [self.languages[int(i)] for i in self.values]
 
+    @property
+    def results(self) -> list[dict]:
+        """Segment-mode results (the ``values`` list, named)."""
+        return list(self.values)
+
 
 @dataclass
 class _Request:
@@ -141,7 +147,20 @@ class _Request:
     deadline: float | None  # absolute time.monotonic()
     trace_id: str
     admitted_at: float
+    # Segment mode: the full option set of the decode (None ⇒ label/score
+    # mode). Requests only coalesce with requests whose options MATCH —
+    # the key below — so one dispatched batch is one (mode, knobs) pair.
+    segment_opts: object | None = None
     future: Future = field(default_factory=Future)
+
+    def batch_key(self):
+        """The coalescing key: result mode + every segment knob. Two
+        requests with different knobs can never share a dispatch (and,
+        downstream, never share cache entries — docs/SERVING.md §11)."""
+        return (
+            self.want_labels,
+            None if self.segment_opts is None else self.segment_opts.key(),
+        )
 
 
 class _StaticSource:
@@ -317,19 +336,27 @@ class ContinuousBatcher:
         *,
         priority: str = INTERACTIVE,
         want_labels: bool = False,
+        segment_options=None,
         deadline_ms: float | None = None,
         trace_id: str | None = None,
     ) -> Future:
         """Admit one request; returns a Future resolving to a
         :class:`ServeResult` (or raising the dispatch error).
 
-        Raises :class:`ServeOverloaded` immediately when the request is
-        shed — admission control fails fast so callers can retry
-        elsewhere instead of queueing into a blown SLO.
+        ``segment_options`` (a :class:`~..segment.SegmentOptions`)
+        switches the request to the span-level segmentation result type;
+        mutually exclusive with ``want_labels``. Raises
+        :class:`ServeOverloaded` immediately when the request is shed —
+        admission control fails fast so callers can retry elsewhere
+        instead of queueing into a blown SLO.
         """
         if priority not in LANES:
             raise ValueError(
                 f"unknown priority {priority!r}; expected one of {LANES}"
+            )
+        if segment_options is not None and want_labels:
+            raise ValueError(
+                "segment_options and want_labels are mutually exclusive"
             )
         docs = list(byte_docs)
         # Chaos gate: an injected error here IS a shed — same counters,
@@ -356,7 +383,8 @@ class ContinuousBatcher:
             fut: Future = Future()
             fut.set_result(ServeResult(
                 values=(
-                    np.zeros(0, np.int32) if want_labels
+                    [] if segment_options is not None
+                    else np.zeros(0, np.int32) if want_labels
                     else np.zeros((0, L), np.float32)
                 ),
                 version=entry.version,
@@ -376,6 +404,7 @@ class ContinuousBatcher:
             deadline=None if deadline_ms is None else now + deadline_ms / 1e3,
             trace_id=tid,
             admitted_at=now,
+            segment_opts=segment_options,
         )
         # Admission is one atomic core call: closed check, queue bound,
         # SLO estimate, and the degraded-bulk probe all under the queue
@@ -401,6 +430,17 @@ class ContinuousBatcher:
     def predict_ids(self, byte_docs: Sequence[bytes], **kw) -> np.ndarray:
         """Blocking convenience: admit + wait; int32 [N] argmax ids."""
         return self.submit(byte_docs, want_labels=True, **kw).result().values
+
+    def segment(self, byte_docs: Sequence[bytes], options=None, **kw) -> list[dict]:
+        """Blocking convenience: admit + wait; one segmentation result
+        dict per document (docs/SEGMENTATION.md)."""
+        if options is None:
+            from ..segment import SegmentOptions
+
+            options = SegmentOptions()
+        return self.submit(
+            byte_docs, segment_options=options, **kw
+        ).result().values
 
     def _count_shed(self, rows: int, reason: str, priority: str) -> None:
         REGISTRY.incr("serve/shed_requests")
@@ -433,10 +473,11 @@ class ContinuousBatcher:
     def _run(self) -> None:
         # The flush-window wait, lane priority, and whole-request
         # coalescing all live in the core queue; requests in one batch
-        # share a result mode (the key) — a mode flip at a lane front ends
-        # the batch there, so the demux below stays a pure offset walk.
+        # share a result mode AND its knobs (the key) — a mode or knob
+        # flip at a lane front ends the batch there, so the demux below
+        # stays a pure offset walk.
         while True:
-            batch = self._queue.next_batch(key=lambda r: r.want_labels)
+            batch = self._queue.next_batch(key=lambda r: r.batch_key())
             if batch is None:
                 return
             try:
@@ -449,6 +490,84 @@ class ContinuousBatcher:
                     ))
             finally:
                 self._queue.done()
+
+    @staticmethod
+    def _cache_scope(entry) -> str:
+        """Cache key scope = model identity + version name. Version names
+        alone repeat across independent sources (every registry
+        auto-names "v1", "v2", ..., every static source pins "v0"), so a
+        cache shared across batchers needs the model uid (persisted with
+        the model — replicas loading one path share entries) or the
+        static source's per-instance token in the key to make "never a
+        wrong answer" structural rather than conventional."""
+        scope = getattr(getattr(entry, "model", None), "uid", None) or (
+            getattr(entry, "uid", None)
+        )
+        return f"{scope}:{entry.version}" if scope else entry.version
+
+    def _segmented(self, entry, docs: list[bytes], opts) -> list[dict]:
+        """One coalesced segment-mode dispatch, through the score cache.
+
+        The cache MODE string carries every decode knob (``opts.key()``:
+        cell, smoothing, k, reject threshold, min-span) plus the
+        calibration content version, so two segment requests with
+        different knobs — or the same knobs across a recalibration — can
+        never cross-answer; a knob change simply addresses different
+        entries (docs/SERVING.md §11). Values are the canonical JSON
+        encoding of the result dict (byte-stable: ``sort_keys`` + the
+        decode's rounded floats), stored as uint8 arrays so the cache's
+        byte accounting and copy-on-store semantics apply unchanged.
+        """
+        import json
+
+        from ..segment import segment_documents
+
+        model = getattr(entry, "model", None)
+        languages = getattr(entry, "languages", None) or (
+            model.profile.languages if model is not None else None
+        )
+        if not languages:
+            raise ServeError(
+                "serving source carries no language names for segment mode"
+            )
+        calibration = getattr(model, "calibration", None)
+        cache = self.cache
+
+        def decode(miss_docs):
+            return segment_documents(
+                entry.runner, miss_docs, languages,
+                options=opts, calibration=calibration,
+            )
+
+        if cache is None:
+            return decode(docs)
+        cal_version = (
+            calibration.version if calibration is not None else "uncal"
+        )
+        mode = f"segment[{opts.key()}][cal={cal_version}]"
+        encoding = getattr(entry.runner, "score_encoding", UTF8)
+        version = self._cache_scope(entry)
+        cached = cache.get_many(version, mode, encoding, docs)
+        miss = [i for i, c in enumerate(cached) if c is None]
+        out: list = [
+            None if c is None else json.loads(bytes(c)) for c in cached
+        ]
+        if miss:
+            miss_docs = [docs[i] for i in miss]
+            miss_out = decode(miss_docs)
+            for j, i in enumerate(miss):
+                out[i] = miss_out[j]
+            cache.put_many(
+                version, mode, encoding, miss_docs,
+                [
+                    np.frombuffer(
+                        json.dumps(r, sort_keys=True).encode("utf-8"),
+                        dtype=np.uint8,
+                    )
+                    for r in miss_out
+                ],
+            )
+        return out
 
     def _scored(self, entry, docs: list[bytes], want_labels: bool):
         """One coalesced dispatch's results, through the score cache.
@@ -468,17 +587,7 @@ class ContinuousBatcher:
             )
         mode = "labels" if want_labels else "scores"
         encoding = getattr(runner, "score_encoding", UTF8)
-        # Key scope = model identity + version name. Version names alone
-        # repeat across independent sources (every registry auto-names
-        # "v1", "v2", ..., every static source pins "v0"), so a cache
-        # shared across batchers needs the model uid (persisted with the
-        # model — replicas loading one path share entries) or the static
-        # source's per-instance token in the key to make "never a wrong
-        # answer" structural rather than conventional.
-        scope = getattr(getattr(entry, "model", None), "uid", None) or (
-            getattr(entry, "uid", None)
-        )
-        version = f"{scope}:{entry.version}" if scope else entry.version
+        version = self._cache_scope(entry)
         cached = cache.get_many(version, mode, encoding, docs)
         miss = [i for i, c in enumerate(cached) if c is None]
         if miss:
@@ -538,6 +647,9 @@ class ContinuousBatcher:
         rows = sum(len(r.docs) for r in live)
         docs = [d for r in live for d in r.docs]
         want_labels = live[0].want_labels
+        # One batch = one batch_key (the queue coalesces on it), so the
+        # lead request's options speak for every coalesced request.
+        segment_opts = live[0].segment_opts
         REGISTRY.set_gauge("langdetect_serve_inflight_rows", rows)
         try:
             with self._source.lease() as entry:
@@ -549,9 +661,14 @@ class ContinuousBatcher:
                 with trace_request(live[0].trace_id), span(
                     "serve/dispatch", rows=rows, requests=len(live),
                     version=entry.version, labels=want_labels,
+                    segment=segment_opts is not None,
                 ):
                     t0 = time.perf_counter()
-                    out = self._scored(entry, docs, want_labels)
+                    out = (
+                        self._segmented(entry, docs, segment_opts)
+                        if segment_opts is not None
+                        else self._scored(entry, docs, want_labels)
+                    )
                     dispatch_s = time.perf_counter() - t0
         except Exception as e:
             REGISTRY.incr("serve/dispatch_errors")
@@ -586,7 +703,13 @@ class ContinuousBatcher:
         done = time.monotonic()
         off = 0
         for req in live:
-            sub = np.array(out[off:off + len(req.docs)])
+            # Segment results are per-doc dicts: slice the list as-is
+            # (an np.array of dicts would be an object array nobody
+            # wants); numeric modes keep the contiguous array copy.
+            if segment_opts is not None:
+                sub = list(out[off:off + len(req.docs)])
+            else:
+                sub = np.array(out[off:off + len(req.docs)])
             off += len(req.docs)
             queue_wait_s = t_start - req.admitted_at
             REGISTRY.observe("serve/queue_wait_s", queue_wait_s)

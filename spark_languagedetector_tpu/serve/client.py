@@ -174,13 +174,49 @@ class ServeClient:
         *,
         priority: str = "interactive",
         deadline_ms: float | None = None,
-    ) -> tuple[list[str], dict]:
-        """(predicted language labels, response metadata)."""
+    ) -> tuple[list, dict]:
+        """(predicted labels, response metadata). When the served model's
+        ``resultMode`` is ``"segment"`` the server answers ``/detect``
+        with segmentation result dicts instead of label strings
+        (``meta["mode"] == "segment"`` says which came back); use
+        :meth:`segment` to request that shape explicitly."""
         payload: dict = {"texts": list(texts), "priority": priority}
         if deadline_ms is not None:
             payload["deadline_ms"] = deadline_ms
         data = self._request("POST", "/detect", payload, idempotent=True)
+        if "results" in data:
+            return data.pop("results"), data
         return data.pop("labels"), data
+
+    def segment(
+        self,
+        texts: Sequence[str],
+        *,
+        top_k: int | None = None,
+        reject_threshold: float | None = None,
+        priority: str = "interactive",
+        deadline_ms: float | None = None,
+        trace_id: str | None = None,
+    ) -> tuple[list[dict], dict]:
+        """(segmentation result dicts, response metadata) via
+        ``/detect?mode=segment`` — byte-offset spans, calibrated top-k,
+        and the unknown reject per document (docs/SEGMENTATION.md).
+        ``top_k``/``reject_threshold`` override the served model's params
+        for this request only (the serve cache keys on them, so mixed-knob
+        traffic never cross-answers)."""
+        payload: dict = {"texts": list(texts), "priority": priority}
+        if top_k is not None:
+            payload["top_k"] = top_k
+        if reject_threshold is not None:
+            payload["reject_threshold"] = reject_threshold
+        if deadline_ms is not None:
+            payload["deadline_ms"] = deadline_ms
+        if trace_id is not None:
+            payload["trace_id"] = trace_id
+        data = self._request(
+            "POST", "/detect?mode=segment", payload, idempotent=True
+        )
+        return data.pop("results"), data
 
     def healthz(self) -> dict:
         return self._request("GET", "/healthz")

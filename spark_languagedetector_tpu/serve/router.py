@@ -374,11 +374,25 @@ class FleetRouter:
         """(labels, response metadata incl. ``replica``)."""
         return self._dispatch(list(texts), want_labels=True, **kw)
 
+    def segment(self, texts, *, top_k=None, reject_threshold=None, **kw):
+        """(segmentation result dicts, response metadata incl.
+        ``replica``) — forwarded verbatim to a replica's
+        ``/detect?mode=segment`` (the replica resolves model-default
+        knobs; docs/SEGMENTATION.md)."""
+        return self._dispatch(
+            list(texts), want_labels=False,
+            segment_kw={
+                "top_k": top_k, "reject_threshold": reject_threshold,
+            },
+            **kw,
+        )
+
     def _dispatch(
         self,
         texts: list,
         *,
         want_labels: bool,
+        segment_kw: dict | None = None,
         priority: str = INTERACTIVE,
         deadline_ms: float | None = None,
         trace_id: str | None = None,
@@ -399,7 +413,13 @@ class FleetRouter:
                     attempt=attempt,
                 ):
                     faults.inject("fleet/dispatch")
-                    if want_labels:
+                    if segment_kw is not None:
+                        out, meta = h.client.segment(
+                            texts, priority=priority,
+                            deadline_ms=deadline_ms, trace_id=trace_id,
+                            **segment_kw,
+                        )
+                    elif want_labels:
                         out, meta = h.client.detect(
                             texts, priority=priority, deadline_ms=deadline_ms
                         )
@@ -564,7 +584,7 @@ class RouterServer(JsonHTTPFront):
         super().__init__(host, port)
 
     # ---------------------------------------------------------- handlers ----
-    def score(self, payload: dict, *, labels: bool) -> dict:
+    def score(self, payload: dict, *, labels: bool, mode: str | None = None) -> dict:
         texts = payload.get("texts", payload.get("docs"))
         if not isinstance(texts, list) or not all(
             isinstance(t, str) for t in texts
@@ -578,11 +598,32 @@ class RouterServer(JsonHTTPFront):
         deadline_ms = payload.get("deadline_ms")
         if deadline_ms is not None:
             deadline_ms = float(deadline_ms)
-        if labels:
+        if mode not in (None, "label", "segment"):
+            raise ValueError(
+                f"unknown mode {mode!r}; expected 'label' or 'segment'"
+            )
+        if labels and mode == "segment":
+            # Forwarded knobs only — the serving replica resolves its
+            # model's defaults, exactly like a direct client would see.
+            out, meta = self.router.segment(
+                texts,
+                top_k=payload.get("top_k"),
+                reject_threshold=payload.get("reject_threshold"),
+                priority=priority, deadline_ms=deadline_ms,
+                trace_id=payload.get("trace_id"),
+            )
+            meta["mode"] = "segment"
+            meta["results"] = out
+        elif labels:
             out, meta = self.router.detect(
                 texts, priority=priority, deadline_ms=deadline_ms
             )
-            meta["labels"] = out
+            if meta.get("mode") == "segment":
+                # The replica's model answered /detect in its own
+                # segment default: keep the honest key.
+                meta["results"] = out
+            else:
+                meta["labels"] = out
         else:
             out, meta = self.router.score(
                 texts, priority=priority, deadline_ms=deadline_ms,

@@ -126,13 +126,27 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(400, {"error": str(e)})
             return
         try:
-            if self.path == "/score":
+            from urllib.parse import parse_qs, urlsplit
+
+            parts = urlsplit(self.path)
+            route = parts.path
+            query = {
+                k: v[-1] for k, v in parse_qs(parts.query).items()
+            }
+            if route == "/score":
                 self._reply(200, self.server.score(payload, labels=False))
-            elif self.path == "/detect":
-                self._reply(200, self.server.score(payload, labels=True))
-            elif self.path == "/admin/swap":
+            elif route == "/detect":
+                # ?mode=segment (or a "mode" body key) switches /detect to
+                # the span-level segmentation result type; an unadorned
+                # /detect follows the active model's resultMode param
+                # (docs/SERVING.md §11, docs/SEGMENTATION.md).
+                mode = query.get("mode", payload.get("mode"))
+                self._reply(
+                    200, self.server.score(payload, labels=True, mode=mode)
+                )
+            elif route == "/admin/swap":
                 self._reply(200, self.server.swap(payload))
-            elif self.path == "/admin/rollback":
+            elif route == "/admin/rollback":
                 self._reply(200, self.server.rollback())
             else:
                 self._reply(404, {"error": f"unknown path {self.path!r}"})
@@ -302,7 +316,37 @@ class ServingServer(JsonHTTPFront):
             self.batcher.close(drain=drain)
 
     # ---------------------------------------------------------- handlers ----
-    def score(self, payload: dict, *, labels: bool) -> dict:
+    def _segment_options(self, payload: dict, model):
+        """Resolve the decode knobs for one ``/detect`` segment request:
+        request body keys (``top_k``, ``reject_threshold``) win, then the
+        active model's ``topK``/``rejectThreshold`` params, then the
+        :class:`~..segment.SegmentOptions` defaults. Validation lives in
+        SegmentOptions itself (a bad knob is a 400, never a dispatch)."""
+        from ..segment import SegmentOptions
+
+        defaults = SegmentOptions()
+        top_k = payload.get("top_k")
+        reject = payload.get("reject_threshold")
+        if top_k is None:
+            top_k = (
+                model.get("topK") if model is not None else defaults.top_k
+            )
+        if reject is None:
+            reject = (
+                model.get("rejectThreshold") if model is not None
+                else defaults.reject_threshold
+            )
+        if not isinstance(top_k, int) or isinstance(top_k, bool):
+            raise ValueError(f'"top_k" must be an integer, got {top_k!r}')
+        if not isinstance(reject, (int, float)) or isinstance(reject, bool):
+            raise ValueError(
+                f'"reject_threshold" must be a number, got {reject!r}'
+            )
+        return SegmentOptions(
+            top_k=int(top_k), reject_threshold=float(reject)
+        )
+
+    def score(self, payload: dict, *, labels: bool, mode: str | None = None) -> dict:
         texts = payload.get("texts", payload.get("docs"))
         if not isinstance(texts, list) or not all(
             isinstance(t, str) for t in texts
@@ -323,13 +367,26 @@ class ServingServer(JsonHTTPFront):
         # the encoding mid-traffic has no well-defined answer for requests
         # already in the queue (docs/SERVING.md §2).
         entry = self.registry.peek()
-        encoding = (
-            entry.model.get("predictEncoding")
-            if entry.model is not None else UTF8
-        )
+        model = entry.model
+        encoding = model.get("predictEncoding") if model is not None else UTF8
+        # /detect result-type resolution: an explicit ?mode= (or body
+        # "mode") wins; otherwise the active model's resultMode param
+        # decides, so a segment-mode model serves segmentation by default
+        # (docs/SEGMENTATION.md).
+        if labels and mode is None and model is not None:
+            mode = model.get("resultMode")
+        if mode not in (None, "label", "segment"):
+            raise ValueError(
+                f"unknown mode {mode!r}; expected 'label' or 'segment'"
+            )
+        segment_options = None
+        if labels and mode == "segment":
+            segment_options = self._segment_options(payload, model)
         docs = [text_to_bytes(t, encoding) for t in texts]
         fut = self.batcher.submit(
-            docs, priority=priority, want_labels=labels,
+            docs, priority=priority,
+            want_labels=labels and segment_options is None,
+            segment_options=segment_options,
             deadline_ms=deadline_ms, trace_id=payload.get("trace_id"),
         )
         result = fut.result()
@@ -339,7 +396,10 @@ class ServingServer(JsonHTTPFront):
             "queue_wait_ms": round(result.queue_wait_s * 1e3, 3),
             "dispatch_ms": round(result.dispatch_s * 1e3, 3),
         }
-        if labels:
+        if segment_options is not None:
+            out["mode"] = "segment"
+            out["results"] = result.results
+        elif labels:
             out["labels"] = result.labels
         else:
             # float() of a float32 is exact (f32 ⊂ f64) and JSON doubles

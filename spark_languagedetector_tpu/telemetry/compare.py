@@ -105,6 +105,16 @@ _TRACKED_RATIOS = {
     # duplicates drifts toward 1.0.
     "cache/hit_rate": ("cache/hits", "cache/lookups"),
     "dedup/unique_ratio": ("dedup/rows_unique", "dedup/rows_in"),
+    # Segmentation confidence contract (docs/SEGMENTATION.md): the
+    # whole-run reject fraction, exact from the decode's counters. On a
+    # FIXED workload the reject rate drifting UP regresses (the default
+    # direction): rejects on the same documents mean the confidence
+    # pipeline — scores, length normalization, or a recalibration —
+    # got worse, even when every latency percentile held steady. The
+    # decode increments ``segment/docs`` unconditionally (zero-reject
+    # runs still carry the denominator and a zero numerator), so a
+    # candidate that STARTS rejecting fails against a clean baseline.
+    "segment/reject_rate": ("segment/rejects", "segment/docs"),
 }
 
 
@@ -364,9 +374,17 @@ def compare_captures(
             )
             continue
         delta = _rel_delta(b_t[name], n_t[name])
-        if delta is None:
-            continue
         higher_better = any(t in name for t in _HIGHER_BETTER)
+        if delta is None:
+            # A lower-better ratio rising off an exactly-zero baseline
+            # (a zero-reject run that starts rejecting: segment/
+            # reject_rate 0 -> anything) has no finite relative delta —
+            # like the reliability counters, the appearance itself is
+            # the regression.
+            if not higher_better and b_t[name] == 0 and n_t[name] > 0:
+                delta = math.inf
+            else:
+                continue
         worse = -delta if higher_better else delta
         flag = ""
         if worse > threshold:

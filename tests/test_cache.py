@@ -401,6 +401,56 @@ def test_shared_cache_does_not_leak_across_models():
     np.testing.assert_array_equal(a2.values, r2.score(docs))
 
 
+def test_segment_cache_does_not_leak_across_knobs_or_models():
+    """Segment-mode cache-key completeness (ISSUE 12 satellite): the mode
+    string carries every decode knob (k, reject threshold, cell, smooth,
+    min-span) plus the calibration version, and the model scope applies
+    exactly as in label/score mode — so two segment requests with
+    different knobs, or against different models through ONE shared
+    cache, can never cross-answer."""
+    from spark_languagedetector_tpu.segment import (
+        SegmentOptions,
+        segment_documents,
+    )
+
+    m1, m2 = _model(1), _model(2)
+    langs = list(m1.profile.languages)
+    docs = texts_to_bytes(["abab", "zz", "abczz"])
+    shared = ScoreCache(max_rows=256, max_bytes=1 << 20)
+
+    def direct(m, opts):
+        return segment_documents(
+            m._get_runner(), docs, langs, options=opts,
+            calibration=m.calibration,
+        )
+
+    opts = SegmentOptions()
+    opts_rej = SegmentOptions(top_k=1, reject_threshold=0.9)
+    with ContinuousBatcher(
+        _reg(m1), max_wait_ms=2, max_rows=64, cache=shared,
+    ) as b1, ContinuousBatcher(
+        _reg(m2), max_wait_ms=2, max_rows=64, cache=shared,
+    ) as b2:
+        a1 = b1.segment(docs, opts)
+        a2 = b2.segment(docs, opts)      # same version name, other model
+        assert a1 == direct(m1, opts)
+        assert a2 == direct(m2, opts)
+        # Knob flip on the same model: keyed separately, both exact.
+        r1 = b1.segment(docs, opts_rej)
+        assert r1 == direct(m1, opts_rej)
+        assert all(x["rejected"] for x in r1)  # 0.9 floor on 2-lang probs
+        # Warm repeats answer from each scope's own entries.
+        assert b1.segment(docs, opts) == a1
+        assert b2.segment(docs, opts) == a2
+    assert shared.stats()["hits"] >= 2 * len(docs)
+
+
+def _reg(model):
+    reg = ModelRegistry()
+    reg.install(model)
+    return reg
+
+
 def test_get_many_put_many_match_per_doc_calls():
     """The batched entry points (what the dispatch loop uses) must be
     observationally identical to a loop of get/put — counters included."""

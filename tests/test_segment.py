@@ -1,0 +1,792 @@
+"""Span-level code-switch segmentation (spark_languagedetector_tpu.segment).
+
+Coverage map (ISSUE 12 acceptance):
+  * span-merge property fuzz — the returned spans partition the document
+    exactly, respect min-span, snap to UTF-8 boundaries;
+  * device parity fuzz — ``BatchRunner.segment_cells`` against the
+    float64 host oracle (``ops.score.window_scores_numpy``) on the
+    gather strategy (dense AND cuckoo membership, chunked long docs
+    included), and fused-vs-gather per-cell parity in interpret mode;
+  * whole-doc pinning — ``score`` bytes are bit-identical around any
+    amount of segment traffic (the new output mode must not perturb the
+    old one);
+  * chaos — segment dispatches ride the degraded ladder at
+    ``score/dispatch`` and stay exact;
+  * the estimator/model vertical — ``resultMode="segment"`` transform/
+    detect, ``calibrate`` determinism + improvement, calibration
+    persistence (bit-exact temperatures, explicit uncalibrated);
+  * serving — batcher segment mode, knob/calibration-version cache
+    isolation, the ``/detect?mode=segment`` HTTP surface, stream parity;
+  * the ``--smoke-segment`` bench gate (trimmed in tier-1, full slow).
+"""
+
+import functools
+import json
+
+import numpy as np
+import pytest
+
+from spark_languagedetector_tpu import LanguageDetector, Table
+from spark_languagedetector_tpu.api.runner import SEGMENT_CELL, BatchRunner
+from spark_languagedetector_tpu.models.estimator import LanguageDetectorModel
+from spark_languagedetector_tpu.ops import score as S
+from spark_languagedetector_tpu.ops.encoding import texts_to_bytes
+from spark_languagedetector_tpu.ops.vocab import EXACT, VocabSpec
+from spark_languagedetector_tpu.resilience import faults
+from spark_languagedetector_tpu.resilience.faults import FaultPlan
+from spark_languagedetector_tpu.resilience.policy import (
+    CircuitBreaker,
+    RetryPolicy,
+)
+from spark_languagedetector_tpu.segment import (
+    UNKNOWN,
+    Calibration,
+    SegmentOptions,
+    fit_calibration,
+    segment_documents,
+    topk_decode,
+)
+from spark_languagedetector_tpu.segment.calibrate import (
+    calibrated_probs,
+    expected_calibration_error,
+    normalize_scores,
+)
+from spark_languagedetector_tpu.segment.spans import (
+    decode_cells,
+    merge_spans,
+    smooth_cells,
+    snap_utf8,
+)
+from spark_languagedetector_tpu.telemetry import REGISTRY
+
+RNG = np.random.default_rng(7)
+LANGS = ("en", "de", "fr")
+
+
+def _counter(name):
+    return int(REGISTRY.snapshot()["counters"].get(name, 0))
+
+
+@functools.lru_cache(maxsize=None)
+def _fitted(seed=3, k=200):
+    """Shared fitted 3-language model (runner jit programs compile per
+    instance — share objects, pay the compiles once)."""
+    import bench
+
+    docs, labels = bench.make_corpus(list(LANGS), 45, mean_len=300,
+                                     seed=seed)
+    return LanguageDetector(list(LANGS), [1, 2, 3], k).fit(
+        Table({"lang": labels, "fulltext": docs})
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _heldout():
+    import bench
+
+    return bench.make_corpus(list(LANGS), 60, mean_len=250, seed=77)
+
+
+def _calibrated(seed=3):
+    model = _fitted(seed)
+    if model.calibration is None:
+        hd, hl = _heldout()
+        model.calibrate(Table({"fulltext": hd, "lang": hl}))
+    return model
+
+
+# ------------------------------------------------------------- options ------
+def test_segment_options_validation_and_key():
+    with pytest.raises(ValueError):
+        SegmentOptions(cell=200)  # not a multiple of 128
+    with pytest.raises(ValueError):
+        SegmentOptions(cell=0)
+    with pytest.raises(ValueError):
+        SegmentOptions(smooth=0)
+    with pytest.raises(ValueError):
+        SegmentOptions(top_k=0)
+    with pytest.raises(ValueError):
+        SegmentOptions(reject_threshold=1.0)
+    with pytest.raises(ValueError):
+        SegmentOptions(min_span_bytes=0)
+    base = SegmentOptions()
+    assert base.key() == SegmentOptions().key()
+    # Every knob must move the key — the cache/coalesce isolation rides it.
+    for other in (
+        SegmentOptions(cell=512),
+        SegmentOptions(smooth=5),
+        SegmentOptions(top_k=1),
+        SegmentOptions(reject_threshold=0.25),
+        SegmentOptions(min_span_bytes=4),
+    ):
+        assert other.key() != base.key()
+
+
+# ------------------------------------------------------ span decoding -------
+def test_smooth_cells_is_clipped_box_mean():
+    cells = np.array([[0.0, 3.0], [3.0, 0.0], [6.0, 3.0]])
+    out = smooth_cells(cells, 3)
+    np.testing.assert_allclose(out[0], [1.5, 1.5])   # rows 0..1
+    np.testing.assert_allclose(out[1], [3.0, 2.0])   # rows 0..2
+    np.testing.assert_allclose(out[2], [4.5, 1.5])   # rows 1..2
+    np.testing.assert_array_equal(smooth_cells(cells, 1), cells)
+
+
+def test_decode_cells_winner_and_margin():
+    winners, margins = decode_cells(np.array([[1.0, 3.0, 2.0],
+                                              [2.0, 2.0, 0.0]]))
+    np.testing.assert_array_equal(winners, [1, 0])  # first-max tie rule
+    np.testing.assert_allclose(margins, [1.0, 0.0])
+    w1, m1 = decode_cells(np.array([[4.0], [2.0]]))
+    np.testing.assert_array_equal(w1, [0, 0])
+    np.testing.assert_array_equal(m1, [0.0, 0.0])
+
+
+def test_snap_utf8_backs_off_continuation_bytes():
+    doc = "aé京b".encode()  # 1 + 2 + 3 + 1 bytes
+    assert snap_utf8(doc, 2) == 1   # inside é
+    assert snap_utf8(doc, 4) == 3   # inside 京
+    assert snap_utf8(doc, 5) == 3
+    assert snap_utf8(doc, 3) == 3   # already a boundary
+    assert snap_utf8(doc, 0) == 0
+    # Arbitrary bytes can't walk the boundary more than 4 steps.
+    junk = bytes([0x80] * 10)
+    assert snap_utf8(junk, 9) == 5
+
+
+def test_merge_spans_heals_lone_cell():
+    cell = 128
+    winners = np.array([0, 0, 0, 1, 0, 0])
+    margins = np.array([2.0, 2.0, 2.0, 0.1, 2.0, 2.0])
+    spans = merge_spans(
+        winners, margins, cell=cell, doc_len=6 * cell,
+        doc=b"x" * (6 * cell), min_span_bytes=256,
+    )
+    assert len(spans) == 1
+    assert (spans[0].start, spans[0].end, spans[0].lang_id) == (0, 768, 0)
+
+
+def test_merge_spans_property_fuzz():
+    """Invariants: exact partition of [0, doc_len), min-span respected
+    (single-span docs exempt), interior boundaries are UTF-8 character
+    starts, adjacent spans differ in language."""
+    rng = np.random.default_rng(5)
+    alphabet = "ab é京ü"  # multi-byte characters on purpose
+    for _ in range(60):
+        cell = int(rng.choice([128, 256]))
+        text = "".join(
+            rng.choice(list(alphabet), size=rng.integers(1, 900))
+        )
+        doc = text.encode()
+        n_cells = max(1, -(-len(doc) // cell))
+        winners = rng.integers(0, 3, n_cells)
+        margins = rng.random(n_cells)
+        min_span = int(rng.choice([1, 16, 64, 300]))
+        spans = merge_spans(
+            winners, margins, cell=cell, doc_len=len(doc), doc=doc,
+            min_span_bytes=min_span,
+        )
+        assert spans[0].start == 0
+        assert spans[-1].end == len(doc)
+        for a, b in zip(spans, spans[1:]):
+            assert a.end == b.start          # no gap, no overlap
+            assert a.lang_id != b.lang_id    # canonical
+            assert (doc[b.start] & 0xC0) != 0x80  # char start
+        if len(spans) > 1:
+            for s in spans:
+                # A snapped boundary can shave at most 3 bytes off the
+                # nominal min-span (a UTF-8 char is ≤ 4 bytes).
+                assert s.end - s.start >= min(min_span, cell) - 3
+
+
+def test_topk_decode_order_reject_and_validation():
+    probs = np.array([0.2, 0.5, 0.2, 0.1])
+    langs = ["a", "b", "c", "d"]
+    entries, label, rejected = topk_decode(probs, langs, 3, 0.0)
+    assert [e["lang"] for e in entries] == ["b", "a", "c"]  # tie: index order
+    assert label == "b" and not rejected
+    entries, label, rejected = topk_decode(probs, langs, 99, 0.6)
+    assert len(entries) == 4
+    assert label == UNKNOWN and rejected
+    with pytest.raises(ValueError):
+        topk_decode(probs, ["a", "b"], 2, 0.0)
+
+
+# ----------------------------------------------------------- calibration ----
+def _synthetic_heldout(n=400, L=4, seed=9, scale=25.0):
+    """Over-confident synthetic logits: true class biased, large scale so
+    the T=1 softmax is ~one-hot while real accuracy is ~75%."""
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, L, n)
+    s = rng.normal(size=(n, L))
+    s[np.arange(n), y] += 0.7
+    return s * scale, y
+
+
+def test_fit_calibration_deterministic_and_improves():
+    s, y = _synthetic_heldout()
+    norm = normalize_scores(s, np.ones(len(s)))
+    a = fit_calibration(norm, y, 4)
+    b = fit_calibration(norm, y, 4)
+    np.testing.assert_array_equal(a.temperatures, b.temperatures)
+    assert a.version == b.version
+    assert a.calibrated and a.meta["heldout_docs"] == len(y)
+    assert a.meta["nll_after"] < a.meta["nll_before"]
+    assert a.meta["ece_after"] < a.meta["ece_before"]
+    assert a.meta["ece_after"] <= 0.10
+    with pytest.raises(ValueError):
+        fit_calibration(norm[:0], y[:0], 4)
+    with pytest.raises(ValueError):
+        fit_calibration(norm, np.full(len(y), 7), 4)
+
+
+def test_calibration_identity_and_dict_roundtrip():
+    ident = Calibration.identity(3)
+    assert not ident.calibrated
+    np.testing.assert_array_equal(ident.temperatures, 1.0)
+    s, y = _synthetic_heldout(L=3)
+    cal = fit_calibration(normalize_scores(s, np.ones(len(s))), y, 3)
+    back = Calibration.from_dict(cal.to_dict())
+    np.testing.assert_array_equal(back.temperatures, cal.temperatures)
+    assert back.version == cal.version and back.meta == cal.meta
+    tampered = cal.to_dict()
+    tampered["temperatures"][0] *= 2.0
+    with pytest.raises(ValueError):
+        Calibration.from_dict(tampered)
+    with pytest.raises(ValueError):
+        Calibration(np.array([1.0, -1.0]))
+
+
+def test_expected_calibration_error_hand_case():
+    # Two perfectly-confident correct + two 0.6-confident wrong answers.
+    probs = np.array([[1.0, 0.0], [1.0, 0.0], [0.6, 0.4], [0.6, 0.4]])
+    y = np.array([0, 0, 1, 1])
+    assert expected_calibration_error(probs, y, bins=10) == pytest.approx(
+        0.5 * 0.0 + 0.5 * 0.6
+    )
+
+
+# --------------------------------------------------------- device parity ----
+def _oracle_cells(runner, model, byte_docs, cell):
+    """float64 host mirror of segment_cells on the runner's own tables."""
+    w = np.asarray(runner.weights, dtype=np.float64)
+    if runner.lut is None and runner.cuckoo is None:
+        sorted_ids = None
+    else:
+        sorted_ids = np.asarray(model.profile.compacted().ids)
+    return S.window_scores_numpy(byte_docs, w, sorted_ids, runner.spec, cell)
+
+
+def _parity_docs(model):
+    import bench
+
+    docs, _ = bench.make_corpus(list(LANGS), 12, mean_len=300, seed=21)
+    byte_docs = texts_to_bytes(docs)
+    byte_docs += [
+        b"", b"a", "köln 京都".encode(),
+        bytes(RNG.integers(0, 256, 700).tolist()),
+        b"x" * 9000,  # > max_chunk: exercises cell-aligned chunking
+    ]
+    return byte_docs
+
+
+def test_segment_cells_matches_host_oracle_gather():
+    model = _fitted()
+    runner = model._get_runner()
+    byte_docs = _parity_docs(model)
+    for cell in (SEGMENT_CELL, 512):
+        cells, scored = runner.segment_cells(byte_docs, cell=cell)
+        assert scored == byte_docs  # no cap configured
+        oracle = _oracle_cells(runner, model, byte_docs, cell)
+        assert len(cells) == len(byte_docs)
+        for got, want, doc in zip(cells, oracle, byte_docs):
+            assert got.shape == (max(1, -(-len(doc) // cell)),
+                                 len(LANGS))
+            np.testing.assert_allclose(got, want, atol=1e-3)
+    # Summing a doc's cells restores the whole-doc score (reduction-order
+    # class).
+    scores = runner.score(byte_docs)
+    cells, _ = runner.segment_cells(byte_docs)
+    np.testing.assert_allclose(
+        np.stack([c.sum(axis=0) for c in cells]), scores,
+        rtol=1e-4, atol=1e-2,
+    )
+
+
+def test_segment_cells_cuckoo_matches_host_oracle():
+    det = LanguageDetector(["de", "en"], [1, 2, 3, 4, 5], 60).set_vocab_mode(
+        "exact"
+    )
+    model = det.fit(Table({
+        "lang": ["de", "en"],
+        "fulltext": ["der schnelle braune fuchs springt über den hund",
+                     "the quick brown fox jumps over the lazy dog"],
+    }))
+    runner = model._get_runner()
+    assert runner.cuckoo is not None
+    byte_docs = texts_to_bytes([
+        "der hund", "the dog", "", "a", "ab", "abcd",
+        "schöne vögel fliegen", "zzzz unrelated",
+    ])
+    cells, _ = runner.segment_cells(byte_docs)
+    oracle = _oracle_cells(runner, model, byte_docs, SEGMENT_CELL)
+    for got, want in zip(cells, oracle):
+        np.testing.assert_allclose(got, want, atol=1e-3)
+
+
+def test_segment_cells_fused_matches_gather():
+    model = _fitted()
+    gr = model._get_runner()
+    fr = BatchRunner(
+        weights=gr.weights, lut=gr.lut, cuckoo=gr.cuckoo, spec=gr.spec,
+        strategy="fused",
+    )
+    assert fr.strategy == "fused"
+    byte_docs = _parity_docs(model)[:8] + [b"", b"zz"]
+    fused, _ = fr.segment_cells(byte_docs)
+    gather, _ = gr.segment_cells(byte_docs)
+    for a, b in zip(fused, gather):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(a, b, atol=1e-3)
+
+
+def test_segment_cells_validation_and_dedup_order():
+    runner = _fitted()._get_runner()
+    with pytest.raises(ValueError):
+        runner.segment_cells([b"x"], cell=200)
+    with pytest.raises(ValueError):
+        runner.segment_cells([b"x"], cell=runner.max_chunk * 2)
+    docs = [b"abab", b"zz", b"abab", b"", b"zz"]
+    cells, scored = runner.segment_cells(docs)
+    assert scored == docs  # duplicates restored in input order
+    np.testing.assert_array_equal(cells[0], cells[2])
+    np.testing.assert_array_equal(cells[1], cells[4])
+    # A runner whose largest bucket equals the cell width has no
+    # cell-aligned chunk stride (overlap eats it) — docs that fit in one
+    # chunk still segment; only a doc that actually needs chunking is
+    # refused.
+    tight = BatchRunner(
+        weights=runner.weights, lut=runner.lut, cuckoo=runner.cuckoo,
+        spec=runner.spec, strategy="gather", length_buckets=(256,),
+    )
+    tight_cells, _ = tight.segment_cells([b"abab", b"z" * 256])
+    assert [c.shape for c in tight_cells] == [(1, len(LANGS))] * 2
+    np.testing.assert_allclose(tight_cells[0], cells[0], atol=1e-3)
+    with pytest.raises(ValueError, match="needs chunking"):
+        tight.segment_cells([b"x" * 300])
+
+
+def test_whole_doc_mode_pinned_around_segment_traffic():
+    """The acceptance pin: whole-doc scoring shares none of the segment
+    dispatch programs — its bytes are identical before and after any
+    segment traffic (gather strategy)."""
+    model = _fitted()
+    runner = model._get_runner()
+    docs = _parity_docs(model)
+    before = runner.score(docs)
+    labels_before = model.transform(
+        Table({"fulltext": [d.decode("utf-8", "ignore") for d in docs[:6]]})
+    ).column("lang")
+    runner.segment_cells(docs)
+    segment_documents(runner, docs, LANGS)
+    after = runner.score(docs)
+    np.testing.assert_array_equal(np.asarray(before), np.asarray(after))
+    labels_after = model.transform(
+        Table({"fulltext": [d.decode("utf-8", "ignore") for d in docs[:6]]})
+    ).column("lang")
+    assert list(labels_before) == list(labels_after)
+
+
+def test_segment_chaos_rides_degraded_ladder():
+    """Transient dispatch faults in segment mode replay/degrade and stay
+    exact — same contract as whole-doc scoring."""
+    model = _fitted()
+    base = model._get_runner()
+    runner = BatchRunner(
+        weights=base.weights, lut=base.lut, cuckoo=base.cuckoo,
+        spec=base.spec,
+        retry_policy=RetryPolicy(max_attempts=1, base_delay_s=0.0),
+        breaker=CircuitBreaker(failure_threshold=3, cooldown_s=0.05),
+    )
+    docs = texts_to_bytes(["der hund läuft", "the dog runs", "chien"])
+    want, _ = runner.segment_cells(docs)
+    d0 = _counter("resilience/degraded_batches")
+    with faults.plan_scope(FaultPlan.parse("score/dispatch:error@1")):
+        got, _ = runner.segment_cells(docs)
+    for a, b in zip(got, want):
+        np.testing.assert_array_equal(a, b)  # host rung reads same tables
+    assert _counter("resilience/degraded_batches") > d0
+    assert runner._degraded_mode
+    runner._degraded_mode = False
+
+
+# ------------------------------------------------- decode orchestration -----
+def test_segment_documents_result_shape_and_telemetry():
+    model = _fitted()
+    runner = model._get_runner()
+    import bench
+
+    seg_docs, truth = bench.make_codeswitch_corpus(list(LANGS), 4, seed=31)
+    byte_docs = texts_to_bytes(seg_docs)
+    d0, s0 = _counter("segment/docs"), _counter("segment/spans")
+    results = segment_documents(runner, byte_docs, LANGS)
+    assert _counter("segment/docs") == d0 + len(byte_docs)
+    assert _counter("segment/spans") >= s0 + len(byte_docs)
+    for r, doc in zip(results, byte_docs):
+        assert set(r) == {"label", "rejected", "calibrated", "topk", "spans"}
+        assert r["calibrated"] is False  # no calibration passed
+        assert r["label"] in LANGS
+        spans = r["spans"]
+        assert spans[0]["start"] == 0 and spans[-1]["end"] == len(doc)
+        for a, b in zip(spans, spans[1:]):
+            assert a["end"] == b["start"]
+        for sp in spans:
+            assert 0.0 <= sp["confidence"] <= 1.0
+    # The corpus is block-switched: the decode must actually find spans.
+    assert sum(len(r["spans"]) for r in results) > len(results)
+
+
+def test_segment_documents_reject_and_topk_knobs():
+    model = _fitted()
+    runner = model._get_runner()
+    docs = texts_to_bytes(["the quick brown fox jumps over the lazy dog"])
+    # Uncalibrated 3-language probs sit near 1/3 — a 0.9 floor rejects.
+    rej = segment_documents(
+        runner, docs, LANGS,
+        options=SegmentOptions(reject_threshold=0.9),
+    )[0]
+    assert rej["label"] == UNKNOWN and rej["rejected"]
+    assert len(rej["topk"]) == 3  # candidates still reported
+    assert all(s["lang"] == UNKNOWN for s in rej["spans"])
+    k1 = segment_documents(
+        runner, docs, LANGS, options=SegmentOptions(top_k=1)
+    )[0]
+    assert len(k1["topk"]) == 1 and not k1["rejected"]
+    with pytest.raises(ValueError):
+        segment_documents(runner, docs, ["only-one"])  # language mismatch
+
+
+# ------------------------------------------------------ estimator vertical --
+def test_model_segment_transform_detect_and_defaults():
+    model = _calibrated()
+    seg = model.copy().set_result_mode("segment").set_top_k(2)
+    seg.calibration = model.calibration
+    texts = ["the quick brown fox", "der schnelle braune fuchs"]
+    out = seg.transform(Table({"fulltext": texts}))
+    parsed = [json.loads(v) for v in out.column("lang")]
+    assert parsed == seg.segment(texts)
+    assert all(len(r["topk"]) == 2 and r["calibrated"] for r in parsed)
+    d = seg.detect(texts[0])
+    assert isinstance(d, dict) and d["label"] == "en"
+    # Label mode untouched by the segment params existing.
+    assert model.detect(texts[1]) == "de"
+    # Estimator stamps the params onto fitted models.
+    import bench
+
+    docs, labels = bench.make_corpus(list(LANGS), 9, mean_len=120, seed=2)
+    det = LanguageDetector(list(LANGS), [1, 2], 50).set_result_mode(
+        "segment"
+    ).set_top_k(2).set_reject_threshold(0.1)
+    fitted = det.fit(Table({"lang": labels, "fulltext": docs}))
+    assert fitted.get("resultMode") == "segment"
+    assert fitted.get("topK") == 2
+    assert fitted.get("rejectThreshold") == 0.1
+    with pytest.raises(ValueError):
+        det.set_result_mode("nonsense")
+    with pytest.raises(ValueError):
+        det.set_reject_threshold(1.5)
+
+
+def test_model_calibrate_deterministic_and_improves():
+    model = _fitted(seed=5)
+    hd, hl = _heldout()
+    heldout = Table({"fulltext": hd, "lang": hl})
+    model.calibrate(heldout)
+    first = model.calibration
+    model.calibrate(heldout)
+    np.testing.assert_array_equal(
+        first.temperatures, model.calibration.temperatures
+    )
+    assert model.calibration.meta["ece_after"] < (
+        model.calibration.meta["ece_before"]
+    )
+    with pytest.raises(ValueError):
+        model.calibrate(Table({"fulltext": ["x"], "lang": ["martian"]}))
+
+
+def test_calibration_persists_with_model(tmp_path):
+    model = _calibrated()
+    seg = model.copy().set_result_mode("segment")
+    seg.calibration = model.calibration
+    path = str(tmp_path / "model")
+    seg.save(path)
+    back = LanguageDetectorModel.load(path)
+    assert back.calibration is not None
+    np.testing.assert_array_equal(
+        back.calibration.temperatures, seg.calibration.temperatures
+    )
+    assert back.calibration.version == seg.calibration.version
+    assert back.calibration.meta == seg.calibration.meta
+    assert back.get("resultMode") == "segment"
+    texts = ["the quick brown fox and der hund"]
+    assert back.segment(texts) == seg.segment(texts)
+    # Overwrite save (the two-rename swap path) stays loadable.
+    seg.save(path)
+    again = LanguageDetectorModel.load(path)
+    assert again.calibration.version == seg.calibration.version
+
+
+def test_uncalibrated_model_is_explicit_never_silent(tmp_path):
+    model = _fitted(seed=11, k=60)
+    assert model.calibration is None
+    path = str(tmp_path / "uncal")
+    model.save(path)
+    back = LanguageDetectorModel.load(path)
+    assert back.calibration is None
+    r = segment_documents(
+        back._get_runner(), texts_to_bytes(["hello there"]), LANGS
+    )[0]
+    assert r["calibrated"] is False
+
+
+def test_save_model_crash_leaves_previous_tree(tmp_path, monkeypatch):
+    """A save that dies mid-build must leave the PREVIOUS model intact
+    at the path (tmp-tree + rename-aside, like api.pipeline saves)."""
+    from spark_languagedetector_tpu.persist import io as pio
+
+    model = _calibrated()
+    path = str(tmp_path / "m")
+    model.save(path)
+    v0 = LanguageDetectorModel.load(path).calibration.version
+
+    real = pio._write_parquet
+    calls = {"n": 0}
+
+    def dying(path_, table):
+        calls["n"] += 1
+        raise RuntimeError("disk died mid-save")
+
+    monkeypatch.setattr(pio, "_write_parquet", dying)
+    with pytest.raises(RuntimeError):
+        model.save(path)
+    monkeypatch.undo()
+    assert calls["n"] == 1
+    back = LanguageDetectorModel.load(path)  # old tree fully intact
+    assert back.calibration.version == v0
+    assert not list(tmp_path.glob(".m.tmp.*"))  # tmp cleaned up
+
+
+def test_reference_layout_drops_calibration_explicitly(tmp_path):
+    model = _calibrated()
+    path = str(tmp_path / "ref")
+    model.write().overwrite().reference_layout().save(path)
+    back = LanguageDetectorModel.load(path)
+    assert back.calibration is None  # dropped, never invented
+
+
+# ----------------------------------------------------------------- serve ----
+def test_batcher_segment_mode_and_knob_isolation():
+    from spark_languagedetector_tpu.serve import ContinuousBatcher, ModelRegistry
+    from spark_languagedetector_tpu.serve.cache import ScoreCache
+
+    model = _calibrated()
+    reg = ModelRegistry()
+    reg.install(model)
+    docs = texts_to_bytes(["the quick fox", "der hund", "chien et chat"])
+    direct = segment_documents(
+        model._get_runner(), docs, LANGS,
+        options=SegmentOptions(), calibration=model.calibration,
+    )
+    direct_k1 = segment_documents(
+        model._get_runner(), docs, LANGS,
+        options=SegmentOptions(top_k=1), calibration=model.calibration,
+    )
+    cache = ScoreCache(max_rows=256, max_bytes=1 << 20)
+    with ContinuousBatcher(
+        reg, max_wait_ms=2, max_rows=64, cache=cache
+    ) as b:
+        assert b.segment(docs) == direct
+        h0 = cache.stats()["hits"]
+        assert b.segment(docs) == direct            # cache hit, identical
+        assert cache.stats()["hits"] >= h0 + len(docs)
+        assert b.segment(docs, SegmentOptions(top_k=1)) == direct_k1
+        assert b.segment(docs) == direct            # k=1 didn't cross-answer
+        # Numeric modes interleave cleanly with segment traffic.
+        np.testing.assert_array_equal(
+            b.submit(docs, want_labels=True).result().values,
+            model._get_runner().predict_ids(docs),
+        )
+        np.testing.assert_array_equal(
+            b.submit(docs).result().values, model._get_runner().score(docs)
+        )
+        # Zero-doc segment request answers immediately.
+        assert b.submit(
+            [], segment_options=SegmentOptions()
+        ).result().values == []
+        with pytest.raises(ValueError):
+            b.submit(docs, want_labels=True,
+                     segment_options=SegmentOptions())
+
+
+def test_recalibration_changes_cache_version():
+    """Same model object, new temperatures ⇒ new calibration version ⇒
+    old cache entries unreachable (fresh misses, fresh results)."""
+    from spark_languagedetector_tpu.serve import ContinuousBatcher, ModelRegistry
+    from spark_languagedetector_tpu.serve.cache import ScoreCache
+
+    model = _fitted(seed=13, k=80)
+    hd, hl = _heldout()
+    model.calibrate(Table({"fulltext": hd[:30], "lang": hl[:30]}))
+    v_first = model.calibration.version
+    reg = ModelRegistry()
+    reg.install(model)
+    docs = texts_to_bytes(["the quick fox jumps"])
+    cache = ScoreCache(max_rows=64, max_bytes=1 << 20)
+    with ContinuousBatcher(
+        reg, max_wait_ms=2, max_rows=64, cache=cache
+    ) as b:
+        b.segment(docs)
+        m0 = cache.stats()["misses"]
+        model.calibrate(Table({"fulltext": hd, "lang": hl}))  # new temps
+        assert model.calibration.version != v_first
+        after = b.segment(docs)
+        # New calibration version ⇒ the old entry is unreachable: the
+        # lookup MISSES and recomputes under the new temperatures (the
+        # decoded dicts may still coincide after rounding — the key
+        # isolation, not the value, is the contract here).
+        assert cache.stats()["misses"] > m0
+        assert after == segment_documents(
+            model._get_runner(), docs, LANGS,
+            options=SegmentOptions(), calibration=model.calibration,
+        )
+
+
+def test_serve_http_segment_endpoint_and_defaults():
+    from spark_languagedetector_tpu.serve.client import ServeClient, ServeHTTPError
+    from spark_languagedetector_tpu.serve.registry import ModelRegistry
+    from spark_languagedetector_tpu.serve.server import ServingServer
+
+    model = _calibrated()
+    reg = ModelRegistry()
+    reg.install(model)
+    srv = ServingServer(reg, port=0, max_wait_ms=2, max_rows=64).start()
+    try:
+        client = ServeClient(*srv.address)
+        texts = ["the quick fox", "der schnelle fuchs"]
+        res, meta = client.segment(texts)
+        assert meta["mode"] == "segment"
+        assert res == model.segment(texts)
+        res1, _ = client.segment(texts, top_k=1)
+        assert all(len(r["topk"]) == 1 for r in res1)
+        rej, _ = client.segment(texts, reject_threshold=0.0)
+        assert all(not r["rejected"] for r in rej)
+        # Plain /detect keeps label mode for a label-mode model...
+        labels, meta2 = client.detect(texts)
+        assert labels == ["en", "de"] and "mode" not in meta2
+        # ...and a bad knob is a 400, never a dispatch.
+        with pytest.raises(ServeHTTPError) as ei:
+            client.segment(texts, top_k=0)
+        assert ei.value.status == 400
+        with pytest.raises(ServeHTTPError) as ei:
+            client.segment(texts, reject_threshold=2.0)
+        assert ei.value.status == 400
+    finally:
+        srv.stop()
+    # A segment-default model answers plain /detect with results dicts.
+    seg = model.copy().set_result_mode("segment")
+    seg.calibration = model.calibration
+    reg2 = ModelRegistry()
+    reg2.install(seg)
+    srv2 = ServingServer(reg2, port=0, max_wait_ms=2, max_rows=64).start()
+    try:
+        client2 = ServeClient(*srv2.address)
+        out, meta = client2.detect(["the quick fox"])
+        assert meta["mode"] == "segment" and isinstance(out[0], dict)
+    finally:
+        srv2.stop()
+
+
+def test_stream_segment_parity_with_batch():
+    from spark_languagedetector_tpu.stream.microbatch import (
+        memory_source,
+        run_stream,
+    )
+
+    model = _calibrated()
+    seg = model.copy().set_result_mode("segment")
+    seg.calibration = model.calibration
+    import bench
+
+    seg_docs, _ = bench.make_codeswitch_corpus(list(LANGS), 6, seed=41)
+    want = seg.transform(Table({"fulltext": seg_docs})).column("lang")
+    got_tables = []
+    query = run_stream(
+        seg, memory_source([{"fulltext": t} for t in seg_docs], 2),
+        got_tables.append,
+    )
+    got = [v for t in got_tables for v in t.column("lang").tolist()]
+    assert got == list(want)
+    assert query.batches == 3
+
+
+# ------------------------------------------------------ regression guard ----
+def test_compare_tracks_segment_reject_rate():
+    from spark_languagedetector_tpu.telemetry.compare import (
+        capture_stats,
+        compare_captures,
+    )
+
+    def capture(docs, rejects):
+        return [
+            {"event": "telemetry.span", "path": "segment/merge",
+             "wall_s": 0.01},
+            {"event": "telemetry.snapshot",
+             "counters": {"segment/docs": docs, "segment/rejects": rejects},
+             "gauges": {}, "histograms": {}},
+        ]
+
+    base = capture_stats(capture(100, 0))
+    worse = capture_stats(capture(100, 20))
+    assert base["tracked"]["segment/reject_rate"] == 0.0
+    # 0 -> 0.2: the appearance itself regresses (zero baseline).
+    _, regressions = compare_captures(base, worse, threshold=0.25)
+    assert any("segment/reject_rate" in r for r in regressions)
+    # Drift up past threshold regresses; drift down never does.
+    b2 = capture_stats(capture(100, 10))
+    w2 = capture_stats(capture(100, 20))
+    _, regressions = compare_captures(b2, w2, threshold=0.25)
+    assert any("segment/reject_rate" in r for r in regressions)
+    _, regressions = compare_captures(w2, b2, threshold=0.25)
+    assert not any("segment/reject_rate" in r for r in regressions)
+
+
+# ------------------------------------------------------- bench smoke gate ---
+def test_bench_smoke_segment_trimmed(tmp_path):
+    """Tier-1-sized segmentation smoke: span F1, calibration ECE, top-k,
+    stream parity, fleet hot-swap staleness, and the whole-doc pin — all
+    five gates hard even in the trimmed size."""
+    import bench
+
+    result = bench.smoke_segment(str(tmp_path / "segment.jsonl"),
+                                 trimmed=True)
+    assert result["ok"], result["errors"]
+    assert result["span_f1"] >= 0.85
+    assert result["topk_hit"] >= 0.98
+    assert result["calibration"]["ece_calibrated"] <= 0.10
+    assert result["calibration"]["ece_calibrated"] < (
+        result["calibration"]["ece_uncalibrated"]
+    )
+    assert result["fleet"]["stale_or_cross_mode"] == 0
+    assert result["fleet"]["cache_hits"] > 0
+    assert result["stream"]["parity"] == 1.0
+    assert result["whole_doc_bit_identical"]
+    assert result["segment_counters"]["docs"] > 0
+
+
+@pytest.mark.slow
+def test_bench_smoke_segment_full(tmp_path):
+    """The full CI gate (slow-marked: tier-1 runs the trimmed variant)."""
+    import bench
+
+    result = bench.smoke_segment(str(tmp_path / "segment_full.jsonl"))
+    assert result["ok"], result["errors"]

@@ -2864,6 +2864,306 @@ def fit_scaling_probe(n_devices: int) -> dict:
     }
 
 
+def smoke_zoo(jsonl_path: str | None = None, *, trimmed: bool = False) -> dict:
+    """CPU-safe multi-tenant model-zoo smoke (docs/SERVING.md §12a).
+
+    Spins up ~32 tenants (distinct seeded models over ONE shared spec, so
+    the whole population costs one compile-cache entry) behind a single
+    zoo-backed HTTP server whose residency budget holds only a quarter of
+    them — every round of the concurrent per-tenant socket clients forces
+    LRU evictions and cold reloads mid-traffic. The script then (1) fires
+    a noisy-neighbor burst at a small-quota tenant and (2) runs one
+    tenant-scoped refit hot-swap mid-traffic.
+
+    Hard gates (``main()`` exits nonzero): per-tenant argmax parity
+    exactly 1.0 against each tenant's own direct runner for the version
+    that answered; zero cross-tenant answers (a pairwise-distinct
+    signature precheck makes parity discriminating, and
+    ``zoo/cross_tenant_rejects`` must stay 0); ≥ 1 residency eviction AND
+    ≥ 1 cold *reload* (a tenant paged out and back) with leased versions
+    never evicted (structural — the LRU skips busy tenants); the noisy
+    burst sheds only the noisy tenant (every victim tenant's queue-local
+    shed tally stays 0); and the refit moves exactly one tenant's
+    version. ``trimmed=True`` is the tier-1-sized variant (fewer
+    tenants/clients, same gates).
+    """
+    import itertools
+    import tempfile
+    import threading
+
+    from spark_languagedetector_tpu import (
+        LanguageDetector,
+        LanguageDetectorModel,
+        Table,
+    )
+    from spark_languagedetector_tpu.ops.encoding import texts_to_bytes
+    from spark_languagedetector_tpu.serve.client import ServeClient, ServeHTTPError
+    from spark_languagedetector_tpu.serve.server import ServingServer
+    from spark_languagedetector_tpu.telemetry import REGISTRY
+    from spark_languagedetector_tpu.telemetry.export import JsonlSink
+    from spark_languagedetector_tpu.zoo import ModelZoo, TenantQuota
+
+    REGISTRY.reset()
+    path = jsonl_path or os.path.join(
+        tempfile.gettempdir(), f"zoo_smoke_{os.getpid()}.jsonl"
+    )
+    sink = JsonlSink(path)
+    REGISTRY.add_sink(sink)
+    server = None
+    try:
+
+        n_tenants = 8 if trimmed else 32
+        n_clients = 4 if trimmed else 6
+        rounds = 10 if trimmed else 16
+        docs_per_req = 4
+        resident_cap = max(3, n_tenants // 4)
+        langs = ["l0", "l1", "l2"]
+        alphabet = "abcdxyz"
+
+        # Eval corpus: fixed random short docs over the shared alphabet.
+        rng = np.random.default_rng(140)
+        letters = np.array(list(alphabet))
+        eval_texts = [
+            "".join(rng.choice(letters, size=int(rng.integers(12, 32))))
+            for _ in range(24)
+        ]
+        eval_docs = texts_to_bytes(eval_texts)
+
+        def tenant_model(seed: int) -> LanguageDetectorModel:
+            # 1-gram tables: a 256-row dense table keeps every cold reload's
+            # runner build O(ms) (the 2-gram 65k-row dense form hits XLA's
+            # slow constant-folding path per rebuilt program), while seeded
+            # random per-byte weights keep tenant signatures distinct.
+            trng = np.random.default_rng(seed)
+            gram_map = {
+                a.encode(): trng.random(len(langs)).tolist() for a in alphabet
+            }
+            return LanguageDetectorModel.from_gram_map(gram_map, [1], langs)
+
+        # Per-tenant models with a pairwise-distinct label signature over the
+        # eval corpus — what makes "parity vs your OWN runner" a
+        # discriminating zero-cross-tenant-answers check. Seeds retry
+        # deterministically on a (vanishingly unlikely) signature collision.
+        tenants = [f"t{i:02d}" for i in range(n_tenants)]
+        models: dict = {}
+        signatures: set = set()
+        expected: dict[tuple[str, str], list[str]] = {}
+        for i, name in enumerate(tenants):
+            for bump in range(0, 5000, 1000):
+                model = tenant_model(200 + i + bump)
+                ids = model._get_runner().predict_ids(eval_docs)
+                sig = tuple(int(x) for x in ids)
+                if sig not in signatures:
+                    signatures.add(sig)
+                    models[name] = model
+                    expected[(name, "v1")] = [langs[x] for x in sig]
+                    break
+            else:
+                raise RuntimeError(f"no distinct signature for {name}")
+        distinct_ok = len(signatures) == n_tenants
+
+        zoo = ModelZoo(
+            resident_models=resident_cap,
+            max_wait_ms=4, max_rows=64, max_queue_rows=512,
+        )
+        for name in tenants:
+            zoo.add_tenant(name, models[name])
+        # The burst target: a deliberately tiny quota lane, outside the
+        # regular rotation so victim tallies are unambiguous.
+        zoo.add_tenant(
+            "noisy", tenant_model(990), quota=TenantQuota(max_queue_rows=8)
+        )
+        server = ServingServer(zoo, port=0).start()
+        host, port = server.address
+
+        refit_tenant = tenants[0]
+        refit_version: list[str | None] = [None]
+        noisy_results = {"expected_sheds": 0, "answered": 0}
+        burst_round = rounds // 3
+        refit_round = rounds - 3
+
+        barrier = threading.Barrier(n_clients)
+        lock = threading.Lock()
+        responses: list[tuple[str, str, int, list]] = []  # tenant, ver, lo, labels
+        errors: list[str] = []
+
+        def drive(ci: int) -> None:
+            crng = np.random.default_rng(400 + ci)
+            client = ServeClient(host, port)
+
+            def one_request(tenant: str, tag: str) -> None:
+                lo = int(crng.integers(0, len(eval_texts) - docs_per_req))
+                texts = eval_texts[lo:lo + docs_per_req]
+                try:
+                    got, meta = client.detect(texts, tenant=tenant)
+                except (ServeHTTPError, OSError) as e:
+                    with lock:
+                        errors.append(f"client {ci} {tag} [{tenant}]: {e}")
+                    return
+                with lock:
+                    responses.append((tenant, meta["version"], lo, got))
+
+            for r in range(rounds):
+                try:
+                    barrier.wait(timeout=120)
+                except threading.BrokenBarrierError:
+                    pass
+                if ci == 0 and r == burst_round:
+                    # Noisy-neighbor burst: each oversized bulk request blows
+                    # the tenant's 8-row quota lane and must shed (503) —
+                    # while every other client is mid-round on its own lane.
+                    for k in range(5):
+                        try:
+                            client.detect(
+                                eval_texts[: 3 * docs_per_req] * 4,
+                                tenant="noisy", priority="bulk",
+                            )
+                            noisy_results["answered"] += 1
+                        except ServeHTTPError as e:
+                            if e.status == 503 and e.shed:
+                                noisy_results["expected_sheds"] += 1
+                            else:
+                                with lock:
+                                    errors.append(f"noisy burst {k}: {e}")
+                        except OSError as e:
+                            # Recorded, not raised: an unhandled error
+                            # here would kill client 0 and silently skip
+                            # the refit leg it also drives.
+                            with lock:
+                                errors.append(f"noisy burst {k}: {e}")
+                    continue
+                if ci == 0 and r == refit_round:
+                    est = LanguageDetector(langs, [1, 2], 100)
+                    docs = (
+                        ["aaa bab caa"] * 6 + ["xxy yxy xyy"] * 6
+                        + ["dcd cdd dzz"] * 6
+                    )
+                    labs = ["l0"] * 6 + ["l1"] * 6 + ["l2"] * 6
+                    ar = zoo.auto_refit(
+                        refit_tenant, est,
+                        refit_every_batches=1, final_refit=False,
+                    )
+                    ar.run(
+                        [Table({"lang": labs, "fulltext": docs})],
+                        max_batches=1,
+                    )
+                    refit_version[0] = zoo.version(refit_tenant)
+                    ids = ar.last_model._get_runner().predict_ids(eval_docs)
+                    with lock:
+                        expected[(refit_tenant, refit_version[0])] = [
+                            langs[int(x)] for x in ids
+                        ]
+                    continue
+                # Stride through the tenant population: every client touches
+                # every tenant over the run, far past the residency cap.
+                tenant = tenants[(ci + r * n_clients) % n_tenants]
+                one_request(tenant, f"round {r}")
+
+        threads = [
+            threading.Thread(target=drive, args=(ci,)) for ci in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+
+        # Victim shed check over the PERSISTENT per-tenant counters, not the
+        # current queue stats: a reload builds a fresh AdmissionQueue whose
+        # local tallies restart at 0, so under eviction churn only the
+        # `zoo/shed/<tenant>` counters can prove a victim never shed.
+        zoo_health = zoo.healthz()
+        pre_stop_counters = REGISTRY.snapshot()["counters"]
+        victim_sheds = sum(
+            int(pre_stop_counters.get(f"zoo/shed/{name}", 0))
+            for name in tenants
+        )
+        cold_reloads = sum(
+            max(0, block["loads"] - 1)
+            for block in zoo_health["tenants"].values()
+        )
+        server.stop()
+        server = None  # stopped cleanly: the finally must not re-stop
+
+        # Parity: every response must match its own tenant's direct runner
+        # for the version that answered — a cross-tenant answer is a
+        # mismatch by construction (distinct signatures).
+        checked = mismatches = 0
+        versions_served: dict[str, set] = {}
+        for tenant, version, lo, got in responses:
+            want = expected.get((tenant, version))
+            checked += 1
+            if want is None or got != want[lo:lo + docs_per_req]:
+                mismatches += 1
+            versions_served.setdefault(tenant, set()).add(version)
+        parity = 1.0 if checked and mismatches == 0 else (
+            round(1.0 - mismatches / checked, 6) if checked else 0.0
+        )
+        swapped = sum(
+            1 for t in tenants if zoo.version(t) != "v1"
+        )
+
+        snap = REGISTRY.snapshot()
+        counters = snap["counters"]
+        noisy_sheds = int(counters.get("zoo/shed/noisy", 0))
+        result = {
+            "smoke_zoo": True,
+            "trimmed": trimmed,
+            "tenants": n_tenants,
+            "resident_cap": resident_cap,
+            "clients": n_clients,
+            "answered": len(responses),
+            "dropped_responses": len(errors),
+            "errors": errors[:5],
+            "signatures_distinct": distinct_ok,
+            "argmax_parity": parity,
+            "evictions": int(counters.get("zoo/evictions", 0)),
+            "cold_loads": int(counters.get("zoo/cold_loads", 0)),
+            "cold_reloads": cold_reloads,
+            "cross_tenant_rejects": int(
+                counters.get("zoo/cross_tenant_rejects", 0)
+            ),
+            "noisy": {
+                "noisy_sheds": noisy_sheds,
+                "expected_sheds": noisy_results["expected_sheds"],
+                "burst_answered": noisy_results["answered"],
+                "victim_sheds": victim_sheds,
+            },
+            "refit": {
+                "tenant": refit_tenant,
+                "version": refit_version[0],
+                "swapped_tenant_versions": swapped,
+            },
+            "residency": zoo_health["residency"],
+            "telemetry": telemetry_block(path),
+        }
+        result["ok"] = bool(
+            not errors
+            and distinct_ok
+            and checked > 0
+            and parity == 1.0
+            and result["cross_tenant_rejects"] == 0
+            and result["evictions"] >= 1
+            and cold_reloads >= 1
+            and noisy_sheds >= 1
+            and noisy_results["expected_sheds"] >= 1
+            and victim_sheds == 0
+            and refit_version[0] == "v2"
+            and swapped == 1
+            and versions_served.get(refit_tenant, set()) >= {"v1"}
+        )
+        return result
+    finally:
+        # Any mid-run failure must not leak the HTTP server, the
+        # per-tenant batcher threads, or the telemetry sink into
+        # the caller's process (tier-1 runs the trimmed variant).
+        if server is not None:
+            try:
+                server.stop()
+            except Exception:
+                pass
+        REGISTRY.remove_sink(sink)
+
+
 def fit_scaling() -> dict:
     """Fit-scaling leg: device fit docs/s and collect bytes on a 1-device
     vs an 8-virtual-device CPU mesh (the test substrate's geometry).
@@ -3668,6 +3968,37 @@ def main():
                     "; ".join(result["errors"])
                     or "gate (parity/staleness/hit-rate/speedup/overhead) "
                     "not met"
+                ),
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        return
+    if "--smoke-zoo" in sys.argv[1:]:
+        # Multi-tenant model-zoo smoke: ~32 tenants behind one zoo-backed
+        # HTTP server, residency budget forcing evictions + cold reloads
+        # mid-traffic, a noisy-neighbor burst at a small-quota tenant,
+        # and one tenant-scoped refit hot-swap. Gates: per-tenant argmax
+        # parity 1.0, zero cross-tenant answers, >=1 eviction AND cold
+        # reload (leases never evicted), victim shed tallies all 0,
+        # refit swaps exactly one tenant's version.
+        args = [a for a in sys.argv[1:] if a != "--smoke-zoo"]
+        flags = [a for a in args if a.startswith("-")]
+        if flags or len(args) > 1:
+            print(
+                f"usage: python bench.py --smoke-zoo [out.jsonl] "
+                f"(got {args})",
+                file=sys.stderr,
+            )
+            sys.exit(2)
+        result = smoke_zoo(args[0] if args else None)
+        print(json.dumps(result), flush=True)
+        if not result["ok"]:
+            print(
+                "zoo smoke FAILED: "
+                + (
+                    "; ".join(result["errors"])
+                    or "gate (parity/cross-tenant/eviction/noisy-neighbor/"
+                    "refit-scope) not met"
                 ),
                 file=sys.stderr,
             )

@@ -439,6 +439,7 @@ class CompareContracts:
     tracked_ratio_names: dict[str, int] = field(default_factory=dict)
     reliability_counters: dict[str, int] = field(default_factory=dict)
     reliability_prefixes: dict[str, int] = field(default_factory=dict)
+    informational_counters: dict[str, int] = field(default_factory=dict)
 
 
 def compare_contracts(compare: PyFile | None) -> CompareContracts:
@@ -462,6 +463,7 @@ def compare_contracts(compare: PyFile | None) -> CompareContracts:
     for const, table in (
         ("_RELIABILITY_COUNTERS", out.reliability_counters),
         ("_RELIABILITY_COUNTER_PREFIXES", out.reliability_prefixes),
+        ("_INFORMATIONAL_COUNTERS", out.informational_counters),
     ):
         node = _module_assign(compare, const)
         for s in _str_elements(node, compare.consts):

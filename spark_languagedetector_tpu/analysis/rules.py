@@ -280,6 +280,15 @@ def check_telemetry_names(scan: Scan) -> list[Violation]:
                 "emit it via REGISTRY.incr or drop it from "
                 "_RELIABILITY_COUNTERS",
             ))
+    for name, line in sorted(cc.informational_counters.items()):
+        if not em.counter(name):
+            out.append(Violation(
+                "R2", COMPARE_REL, line,
+                f"informational counter {name!r} is diffed but never "
+                "emitted",
+                "emit it via REGISTRY.incr or drop it from "
+                "_INFORMATIONAL_COUNTERS",
+            ))
     for prefix, line in sorted(cc.reliability_prefixes.items()):
         if not em.any_prefix_overlap(prefix):
             out.append(Violation(

@@ -114,6 +114,13 @@ KNOBS: dict[str, Knob] = _knobs(
          tunable=True, positive=True),
     Knob("serve_slo_ms", "LANGDETECT_SERVE_SLO_MS", "float", 0.0,
          "estimated-wait shed threshold (0: off)"),
+    # --- model zoo (multi-tenant serving: docs/SERVING.md §12) ------------
+    Knob("zoo_resident_bytes", "LANGDETECT_ZOO_RESIDENT_BYTES", "int", None,
+         "resident weight-table byte budget for the model zoo (unset: "
+         "unlimited)", positive=True),
+    Knob("zoo_resident_models", "LANGDETECT_ZOO_RESIDENT_MODELS", "int", None,
+         "resident model bound for the model zoo (unset: unlimited)",
+         positive=True),
     # --- fleet (replicated serving: router + replicas) --------------------
     Knob("fleet_replicas", "LANGDETECT_FLEET_REPLICAS", "int", 3,
          "serve replicas behind the fleet router", positive=True),

@@ -373,6 +373,15 @@ class AdmissionQueue:
         # signal. Zero until the first dispatch lands.
         self.ema_rows_per_s = 0.0
         self.closed = False
+        # Queue-local shed accounting (everything except "closed", which
+        # is lifecycle, not backpressure). The process-global REGISTRY
+        # counters aggregate across queues; these per-queue tallies are
+        # what lets a multi-tenant front end attribute sheds to the ONE
+        # queue that rejected (docs/SERVING.md §12: a noisy tenant's
+        # burst must show up on that tenant's queue and nowhere else).
+        self.shed_requests = 0
+        self.shed_rows = 0
+        self.shed_reasons: dict[str, int] = {}
 
     # ------------------------------------------------------------- admit ----
     def admit(self, item, rows: int, lane: str) -> tuple[str | None, float]:
@@ -397,18 +406,26 @@ class AdmissionQueue:
                 else 0.0
             )
             if self.queued_rows + rows > self.max_queue_rows:
-                return "queue_full", wait_s
+                return self._shed_locked("queue_full", rows), wait_s
             if self.slo_s > 0 and wait_s > self.slo_s:
-                return "slo", wait_s
+                return self._shed_locked("slo", rows), wait_s
             if self._shed_probe is not None:
                 reason = self._shed_probe(lane)
                 if reason is not None:
-                    return reason, wait_s
+                    return self._shed_locked(reason, rows), wait_s
             self._queues[lane].append((item, int(rows), self._clock()))
             self.queued_rows += rows
             self._notify_change_locked()
             self._cv.notify_all()
         return None, wait_s
+
+    def _shed_locked(self, reason: str, rows: int) -> str:
+        """Tally one shed in the queue-local accounting (caller holds the
+        lock) and hand the reason back for the admit return."""
+        self.shed_requests += 1
+        self.shed_rows += rows
+        self.shed_reasons[reason] = self.shed_reasons.get(reason, 0) + 1
+        return reason
 
     def _notify_change_locked(self) -> None:
         if self._on_change is not None:
@@ -518,4 +535,7 @@ class AdmissionQueue:
                 "max_queue_rows": self.max_queue_rows,
                 "slo_ms": self.slo_s * 1e3,
                 "closed": self.closed,
+                "shed_requests": self.shed_requests,
+                "shed_rows": self.shed_rows,
+                "shed_reasons": dict(self.shed_reasons),
             }

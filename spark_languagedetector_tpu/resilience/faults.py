@@ -64,6 +64,14 @@ Sites (one hook per serving layer; docs/RESILIENCE.md §4):
     two-phase hot-swap (every phase-1 prepare, every phase-2 commit):
     plans abort phase 1 everywhere or crash mid-phase-2 and replay the
     rollback deterministically (docs/SERVING.md §9).
+  * ``zoo/load``       — each tenant cold-load attempt in the model zoo
+    (:meth:`zoo.ModelZoo`'s residency manager paging a tenant's tables
+    back in): a firing ``error`` makes THAT tenant's request degrade to
+    an explicit 503 + Retry-After shed — never a wrong-tenant answer —
+    while every other tenant keeps serving (docs/SERVING.md §12). The
+    call counter advances per attempt, so ``@1`` fails exactly the
+    first cold load and its retry reloads cleanly, replaying
+    deterministically like ``serve/cache``.
 """
 
 from __future__ import annotations
@@ -93,6 +101,7 @@ SITES = (
     "fleet/probe",
     "fleet/dispatch",
     "fleet/swap",
+    "zoo/load",
 )
 
 KINDS = ("error", "delay", "poison")

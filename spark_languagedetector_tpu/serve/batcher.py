@@ -216,6 +216,7 @@ class ContinuousBatcher:
         cache=None,
         cache_enable: bool | None = None,
         name: str = "serve",
+        tenant: str | None = None,
     ):
         if not hasattr(source, "lease"):
             source = _StaticSource(source)
@@ -240,6 +241,12 @@ class ContinuousBatcher:
         # startup with zero extra plumbing.
         self.shed_bulk_when_degraded = shed_bulk_when_degraded
         self.name = name
+        # Tenant scope (docs/SERVING.md §12): set by the model zoo's
+        # per-tenant runtime. Partitions the shared score cache's key
+        # space per tenant (same-named versions across tenants can never
+        # cross-answer, structurally) and attributes sheds to the tenant
+        # (``zoo/shed/<tenant>``) on top of the global serve counters.
+        self.tenant = tenant
         # The execution core's admission queue owns lanes, bounds, the
         # flush window, and the shed policy; the batcher supplies the
         # serving-specific pieces — the degraded-bulk probe and the gauge
@@ -446,6 +453,8 @@ class ContinuousBatcher:
         REGISTRY.incr("serve/shed_requests")
         REGISTRY.incr("serve/shed_rows", rows)
         REGISTRY.incr(f"serve/shed_{reason}")
+        if self.tenant is not None:
+            REGISTRY.incr(f"zoo/shed/{self.tenant}")
         log_event(
             _log, "serve.shed", reason=reason, rows=rows, priority=priority,
             queued_rows=self._queue.queued_rows, trace_id=current_trace_id(),
@@ -491,19 +500,26 @@ class ContinuousBatcher:
             finally:
                 self._queue.done()
 
-    @staticmethod
-    def _cache_scope(entry) -> str:
-        """Cache key scope = model identity + version name. Version names
-        alone repeat across independent sources (every registry
-        auto-names "v1", "v2", ..., every static source pins "v0"), so a
-        cache shared across batchers needs the model uid (persisted with
-        the model — replicas loading one path share entries) or the
-        static source's per-instance token in the key to make "never a
-        wrong answer" structural rather than conventional."""
+    def _cache_scope(self, entry) -> str:
+        """Cache key scope = tenant + model identity + version name.
+        Version names alone repeat across independent sources (every
+        registry auto-names "v1", "v2", ..., every static source pins
+        "v0"), so a cache shared across batchers needs the model uid
+        (persisted with the model — replicas loading one path share
+        entries) or the static source's per-instance token in the key to
+        make "never a wrong answer" structural rather than conventional.
+        A tenant-scoped batcher (the model zoo's) additionally prefixes
+        its tenant, partitioning the shared cache's namespace per tenant
+        — two tenants with same-named versions (or even one shared model
+        object) structurally address disjoint entries, across any number
+        of eviction/reload cycles (docs/SERVING.md §12)."""
         scope = getattr(getattr(entry, "model", None), "uid", None) or (
             getattr(entry, "uid", None)
         )
-        return f"{scope}:{entry.version}" if scope else entry.version
+        scope = f"{scope}:{entry.version}" if scope else entry.version
+        if self.tenant is not None:
+            scope = f"tenant:{self.tenant}|{scope}"
+        return scope
 
     def _segmented(self, entry, docs: list[bytes], opts) -> list[dict]:
         """One coalesced segment-mode dispatch, through the score cache.

@@ -63,7 +63,14 @@ class ServeHTTPError(RuntimeError):
 
 
 class ServeClient:
-    """JSON client for one serving endpoint (host, port)."""
+    """JSON client for one serving endpoint (host, port).
+
+    ``tenant`` names this client's tenant against a model-zoo-backed
+    server (docs/SERVING.md §12): every ``score``/``detect``/``segment``
+    call carries it unless overridden per call. Unset (the default), the
+    client is byte-identical to the pre-zoo wire — a zoo server answers
+    from its default tenant, a single-model server exactly as before.
+    """
 
     def __init__(
         self,
@@ -72,11 +79,13 @@ class ServeClient:
         *,
         timeout_s: float = 60.0,
         retry_policy: RetryPolicy | None = None,
+        tenant: str | None = None,
     ):
         self.host = host
         self.port = port
         self.timeout_s = timeout_s
         self.retry_policy = retry_policy
+        self.tenant = tenant
 
     # ------------------------------------------------------------- wire -----
     def _request_once(
@@ -146,6 +155,12 @@ class ServeClient:
                     time.sleep(delay)
 
     # -------------------------------------------------------------- api -----
+    def _tenant_key(self, payload: dict, tenant: str | None) -> dict:
+        tenant = self.tenant if tenant is None else tenant
+        if tenant is not None:
+            payload["tenant"] = tenant
+        return payload
+
     def score(
         self,
         texts: Sequence[str],
@@ -153,6 +168,7 @@ class ServeClient:
         priority: str = "interactive",
         deadline_ms: float | None = None,
         trace_id: str | None = None,
+        tenant: str | None = None,
     ) -> tuple[np.ndarray, dict]:
         """(float32 [N, L] scores, response metadata). The JSON wire is
         bit-transparent for float32 (exact f64 embed + round-tripping
@@ -162,6 +178,7 @@ class ServeClient:
             payload["deadline_ms"] = deadline_ms
         if trace_id is not None:
             payload["trace_id"] = trace_id
+        self._tenant_key(payload, tenant)
         data = self._request("POST", "/score", payload, idempotent=True)
         scores = np.asarray(data.pop("scores"), dtype=np.float32)
         if scores.size == 0:
@@ -174,6 +191,7 @@ class ServeClient:
         *,
         priority: str = "interactive",
         deadline_ms: float | None = None,
+        tenant: str | None = None,
     ) -> tuple[list, dict]:
         """(predicted labels, response metadata). When the served model's
         ``resultMode`` is ``"segment"`` the server answers ``/detect``
@@ -183,6 +201,7 @@ class ServeClient:
         payload: dict = {"texts": list(texts), "priority": priority}
         if deadline_ms is not None:
             payload["deadline_ms"] = deadline_ms
+        self._tenant_key(payload, tenant)
         data = self._request("POST", "/detect", payload, idempotent=True)
         if "results" in data:
             return data.pop("results"), data
@@ -197,6 +216,7 @@ class ServeClient:
         priority: str = "interactive",
         deadline_ms: float | None = None,
         trace_id: str | None = None,
+        tenant: str | None = None,
     ) -> tuple[list[dict], dict]:
         """(segmentation result dicts, response metadata) via
         ``/detect?mode=segment`` — byte-offset spans, calibrated top-k,
@@ -213,6 +233,7 @@ class ServeClient:
             payload["deadline_ms"] = deadline_ms
         if trace_id is not None:
             payload["trace_id"] = trace_id
+        self._tenant_key(payload, tenant)
         data = self._request(
             "POST", "/detect?mode=segment", payload, idempotent=True
         )
@@ -243,15 +264,23 @@ class ServeClient:
     def varz(self) -> dict:
         return self._request("GET", "/varz")
 
-    def swap(self, path: str, *, version: str | None = None) -> str:
+    def swap(
+        self,
+        path: str,
+        *,
+        version: str | None = None,
+        tenant: str | None = None,
+    ) -> str:
         payload: dict = {"path": path}
         if version is not None:
             payload["version"] = version
+        self._tenant_key(payload, tenant)
         return self._request(
             "POST", "/admin/swap", payload, idempotent=False
         )["version"]
 
-    def rollback(self) -> str:
+    def rollback(self, *, tenant: str | None = None) -> str:
+        payload = self._tenant_key({}, tenant)
         return self._request(
-            "POST", "/admin/rollback", idempotent=False
+            "POST", "/admin/rollback", payload or None, idempotent=False
         )["version"]

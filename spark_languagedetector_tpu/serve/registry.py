@@ -326,6 +326,14 @@ class ModelRegistry:
     def current_version(self) -> str:
         return self.peek().version
 
+    def busy(self) -> bool:
+        """True while ANY version in history holds an in-flight lease —
+        the model zoo's residency manager refuses to page out a tenant
+        whose registry reports busy, which is what makes "evictions never
+        touch a leased version" structural (docs/SERVING.md §12)."""
+        with self._lock:
+            return any(e.inflight > 0 for e in self._history)
+
     def versions(self) -> list[dict]:
         with self._lock:
             active = self._active_idx

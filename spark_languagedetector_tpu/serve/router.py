@@ -396,6 +396,7 @@ class FleetRouter:
         priority: str = INTERACTIVE,
         deadline_ms: float | None = None,
         trace_id: str | None = None,
+        tenant: str | None = None,
     ):
         rows = len(texts)
         excluded: set[str] = set()
@@ -413,20 +414,26 @@ class FleetRouter:
                     attempt=attempt,
                 ):
                     faults.inject("fleet/dispatch")
+                    # The tenant rides the request to whichever replica
+                    # wins: every replica fronts the same zoo surface, so
+                    # tenant routing is the replica's (SERVING.md §12) —
+                    # the fleet tier only has to carry the name.
                     if segment_kw is not None:
                         out, meta = h.client.segment(
                             texts, priority=priority,
                             deadline_ms=deadline_ms, trace_id=trace_id,
-                            **segment_kw,
+                            tenant=tenant, **segment_kw,
                         )
                     elif want_labels:
                         out, meta = h.client.detect(
-                            texts, priority=priority, deadline_ms=deadline_ms
+                            texts, priority=priority,
+                            deadline_ms=deadline_ms, tenant=tenant,
                         )
                     else:
                         out, meta = h.client.score(
                             texts, priority=priority,
                             deadline_ms=deadline_ms, trace_id=trace_id,
+                            tenant=tenant,
                         )
             except ServeHTTPError as e:
                 self._release(h, rows)
@@ -602,6 +609,11 @@ class RouterServer(JsonHTTPFront):
             raise ValueError(
                 f"unknown mode {mode!r}; expected 'label' or 'segment'"
             )
+        # Tenant pass-through (SERVING.md §12): the router front carries
+        # the request's tenant to the serving replica untouched — the
+        # replica's zoo resolves it (or 400s on a non-zoo replica),
+        # exactly as a direct client would see.
+        tenant = payload.get("tenant")
         if labels and mode == "segment":
             # Forwarded knobs only — the serving replica resolves its
             # model's defaults, exactly like a direct client would see.
@@ -610,13 +622,14 @@ class RouterServer(JsonHTTPFront):
                 top_k=payload.get("top_k"),
                 reject_threshold=payload.get("reject_threshold"),
                 priority=priority, deadline_ms=deadline_ms,
-                trace_id=payload.get("trace_id"),
+                trace_id=payload.get("trace_id"), tenant=tenant,
             )
             meta["mode"] = "segment"
             meta["results"] = out
         elif labels:
             out, meta = self.router.detect(
-                texts, priority=priority, deadline_ms=deadline_ms
+                texts, priority=priority, deadline_ms=deadline_ms,
+                tenant=tenant,
             )
             if meta.get("mode") == "segment":
                 # The replica's model answered /detect in its own
@@ -627,16 +640,30 @@ class RouterServer(JsonHTTPFront):
         else:
             out, meta = self.router.score(
                 texts, priority=priority, deadline_ms=deadline_ms,
-                trace_id=payload.get("trace_id"),
+                trace_id=payload.get("trace_id"), tenant=tenant,
             )
             # f32 -> f64 -> JSON double round-trips exactly, so routing
             # through this tier stays bit-transparent end to end.
             meta["scores"] = [[float(v) for v in row] for row in out]
         return meta
 
+    @staticmethod
+    def _reject_tenant(payload: dict | None) -> None:
+        # The fleet swap/rollback is whole-fleet and single-model by
+        # construction; silently performing it for a request that named a
+        # tenant would mutate the WRONG model (SERVING.md §12's loud-400
+        # contract). Tenant-scoped admin goes to a replica's own surface.
+        if payload and payload.get("tenant") is not None:
+            raise ValueError(
+                '"tenant" is not supported by the fleet admin surface; '
+                "send tenant-scoped swaps to a zoo-backed replica's "
+                "/admin endpoints"
+            )
+
     def swap(self, payload: dict) -> dict:
         if not self.admin:
             raise ServeError("admin endpoints disabled")
+        self._reject_tenant(payload)
         if self.fleet is None:
             raise ServeError("no fleet attached to this router front end")
         path = payload.get("path")
@@ -645,9 +672,10 @@ class RouterServer(JsonHTTPFront):
         version = self.fleet.swap(path, version=payload.get("version"))
         return {"version": version}
 
-    def rollback(self) -> dict:
+    def rollback(self, payload: dict | None = None) -> dict:
         if not self.admin:
             raise ServeError("admin endpoints disabled")
+        self._reject_tenant(payload)
         if self.fleet is None:
             raise ServeError("no fleet attached to this router front end")
         return {"version": self.fleet.rollback()}

@@ -147,7 +147,7 @@ class _Handler(BaseHTTPRequestHandler):
             elif route == "/admin/swap":
                 self._reply(200, self.server.swap(payload))
             elif route == "/admin/rollback":
-                self._reply(200, self.server.rollback())
+                self._reply(200, self.server.rollback(payload))
             else:
                 self._reply(404, {"error": f"unknown path {self.path!r}"})
         except ServeOverloaded as e:
@@ -284,12 +284,16 @@ class JsonHTTPFront(ThreadingHTTPServer):
 
 
 class ServingServer(JsonHTTPFront):
-    """HTTP front end bound to a registry + batcher.
+    """HTTP front end bound to a registry + batcher, or to a model zoo.
 
-    ``registry`` may be a :class:`~.registry.ModelRegistry` or a fitted
-    ``LanguageDetectorModel`` (wrapped into a fresh registry). The
-    batcher defaults to env-tuned knobs; pass one to share it with
-    in-process callers. ``port=0`` binds an ephemeral port (tests).
+    ``registry`` may be a :class:`~.registry.ModelRegistry`, a fitted
+    ``LanguageDetectorModel`` (wrapped into a fresh registry), or a
+    :class:`~..zoo.ModelZoo` — the multi-tenant form (docs/SERVING.md
+    §12): requests carry an optional ``"tenant"`` key, routed to that
+    tenant's registry + batcher (no key ⇒ the zoo's default tenant,
+    bit-identical to the single-model surface). The batcher defaults to
+    env-tuned knobs; pass one to share it with in-process callers.
+    ``port=0`` binds an ephemeral port (tests).
     """
 
     def __init__(
@@ -302,18 +306,51 @@ class ServingServer(JsonHTTPFront):
         admin: bool = True,
         **batcher_kw,
     ):
-        if not hasattr(registry, "lease"):
-            model, registry = registry, ModelRegistry()
-            registry.install(model)
-        self.registry = registry
-        self._own_batcher = batcher is None
-        self.batcher = batcher or ContinuousBatcher(registry, **batcher_kw)
+        if hasattr(registry, "runtime") and hasattr(registry, "tenants"):
+            # A ModelZoo (duck-typed: the serve package must not import
+            # the zoo eagerly). Per-tenant batchers live in the zoo.
+            self.zoo = registry
+            self.registry = None
+            self._own_batcher = False
+            self.batcher = None
+        else:
+            if not hasattr(registry, "lease"):
+                model, registry = registry, ModelRegistry()
+                registry.install(model)
+            self.zoo = None
+            self.registry = registry
+            self._own_batcher = batcher is None
+            self.batcher = batcher or ContinuousBatcher(
+                registry, **batcher_kw
+            )
         self.admin = admin
         super().__init__(host, port)
 
     def _teardown(self, drain: bool) -> None:
-        if self._own_batcher:
+        if self.zoo is not None:
+            self.zoo.close(drain=drain)
+        elif self._own_batcher:
             self.batcher.close(drain=drain)
+
+    # ------------------------------------------------------ tenant routing --
+    def _route(self, payload: dict):
+        """(registry, batcher, tenant name or None) for one request.
+
+        Single-model servers reject an explicit tenant loudly (a 400 —
+        silently ignoring it could answer from the wrong model). On a
+        zoo, an absent/None tenant resolves to the default tenant; an
+        unknown tenant is a 400; a failed cold load is that tenant's
+        503 + Retry-After (docs/SERVING.md §12).
+        """
+        tenant = payload.get("tenant")
+        if self.zoo is None:
+            if tenant is not None:
+                raise ValueError(
+                    '"tenant" requires a model-zoo-backed server'
+                )
+            return self.registry, self.batcher, None
+        entry, rt = self.zoo.runtime(tenant)
+        return rt.registry, rt.batcher, entry.name
 
     # ---------------------------------------------------------- handlers ----
     def _segment_options(self, payload: dict, model):
@@ -360,42 +397,63 @@ class ServingServer(JsonHTTPFront):
         deadline_ms = payload.get("deadline_ms")
         if deadline_ms is not None:
             deadline_ms = float(deadline_ms)
-        # Encoding is resolved at ADMISSION against the active version; a
-        # concurrent swap that also changes predictEncoding could dispatch
-        # these bytes on the new version. Keep predictEncoding consistent
-        # across versions you hot-swap between (or drain first) — swapping
-        # the encoding mid-traffic has no well-defined answer for requests
-        # already in the queue (docs/SERVING.md §2).
-        entry = self.registry.peek()
-        model = entry.model
-        encoding = model.get("predictEncoding") if model is not None else UTF8
-        # /detect result-type resolution: an explicit ?mode= (or body
-        # "mode") wins; otherwise the active model's resultMode param
-        # decides, so a segment-mode model serves segmentation by default
-        # (docs/SEGMENTATION.md).
-        if labels and mode is None and model is not None:
-            mode = model.get("resultMode")
-        if mode not in (None, "label", "segment"):
-            raise ValueError(
-                f"unknown mode {mode!r}; expected 'label' or 'segment'"
+        want_mode = mode
+        # A zoo-backed request may race a residency eviction between
+        # resolving its tenant's runtime and admitting: the closed
+        # batcher rejects explicitly, and re-resolving takes the
+        # cold-load path — bounded, so a genuinely closed server still
+        # answers 503 rather than looping (docs/SERVING.md §12).
+        for attempt in range(3):
+            registry, batcher, tenant = self._route(payload)
+            # Encoding is resolved at ADMISSION against the active
+            # version; a concurrent swap that also changes
+            # predictEncoding could dispatch these bytes on the new
+            # version. Keep predictEncoding consistent across versions
+            # you hot-swap between (or drain first) — swapping the
+            # encoding mid-traffic has no well-defined answer for
+            # requests already in the queue (docs/SERVING.md §2).
+            entry = registry.peek()
+            model = entry.model
+            encoding = (
+                model.get("predictEncoding") if model is not None else UTF8
             )
-        segment_options = None
-        if labels and mode == "segment":
-            segment_options = self._segment_options(payload, model)
-        docs = [text_to_bytes(t, encoding) for t in texts]
-        fut = self.batcher.submit(
-            docs, priority=priority,
-            want_labels=labels and segment_options is None,
-            segment_options=segment_options,
-            deadline_ms=deadline_ms, trace_id=payload.get("trace_id"),
-        )
-        result = fut.result()
+            # /detect result-type resolution: an explicit ?mode= (or body
+            # "mode") wins; otherwise the active model's resultMode param
+            # decides, so a segment-mode model serves segmentation by
+            # default (docs/SEGMENTATION.md).
+            mode = want_mode
+            if labels and mode is None and model is not None:
+                mode = model.get("resultMode")
+            if mode not in (None, "label", "segment"):
+                raise ValueError(
+                    f"unknown mode {mode!r}; expected 'label' or 'segment'"
+                )
+            segment_options = None
+            if labels and mode == "segment":
+                segment_options = self._segment_options(payload, model)
+            docs = [text_to_bytes(t, encoding) for t in texts]
+            try:
+                fut = batcher.submit(
+                    docs, priority=priority,
+                    want_labels=labels and segment_options is None,
+                    segment_options=segment_options,
+                    deadline_ms=deadline_ms,
+                    trace_id=payload.get("trace_id"),
+                )
+                result = fut.result()
+            except ServeClosed:
+                if self.zoo is None or attempt == 2:
+                    raise
+                continue
+            break
         out = {
             "version": result.version,
             "trace_id": result.trace_id,
             "queue_wait_ms": round(result.queue_wait_s * 1e3, 3),
             "dispatch_ms": round(result.dispatch_s * 1e3, 3),
         }
+        if tenant is not None:
+            out["tenant"] = tenant
         if segment_options is not None:
             out["mode"] = "segment"
             out["results"] = result.results
@@ -415,12 +473,31 @@ class ServingServer(JsonHTTPFront):
         path = payload.get("path")
         if not isinstance(path, str) or not path:
             raise ValueError('"path" must name a saved model directory')
+        if self.zoo is not None:
+            tenant = payload.get("tenant")
+            version = self.zoo.load(
+                tenant, path, version=payload.get("version")
+            )
+            return {
+                "version": version,
+                "tenant": tenant or self.zoo.default_tenant,
+            }
+        if payload.get("tenant") is not None:
+            raise ValueError('"tenant" requires a model-zoo-backed server')
         version = self.registry.load(path, version=payload.get("version"))
         return {"version": version}
 
-    def rollback(self) -> dict:
+    def rollback(self, payload: dict | None = None) -> dict:
         if not self.admin:
             raise ServeError("admin endpoints disabled")
+        if self.zoo is not None:
+            tenant = (payload or {}).get("tenant")
+            return {
+                "version": self.zoo.rollback(tenant),
+                "tenant": tenant or self.zoo.default_tenant,
+            }
+        if payload is not None and payload.get("tenant") is not None:
+            raise ValueError('"tenant" requires a model-zoo-backed server')
         return {"version": self.registry.rollback()}
 
     def readyz(self) -> dict:
@@ -436,6 +513,22 @@ class ServingServer(JsonHTTPFront):
         version = None
         if self._draining:
             reasons.append("draining")
+        if self.zoo is not None:
+            # Zoo readiness: the default tenant must at least be
+            # registered (resident or cold — a cold tenant is servable
+            # after its first-request load). Per-tenant detail lives in
+            # the healthz/varz zoo blocks.
+            try:
+                version = self.zoo.version(None)
+            except ServeError:
+                reasons.append("no_default_tenant")
+            return {
+                "ready": not reasons,
+                "reasons": reasons,
+                "version": version,
+                "tenants": len(self.zoo.tenants()),
+                "draining": self._draining,
+            }
         try:
             entry = self.registry.peek()
             version = entry.version
@@ -463,12 +556,21 @@ class ServingServer(JsonHTTPFront):
             "reasons": ready["reasons"],
             "draining": self._draining,
             "uptime_s": round(time.monotonic() - self._started, 3),
-            "batcher": self.batcher.stats(),
-            "cache": (
-                None if self.batcher.cache is None
-                else self.batcher.cache.stats()
-            ),
         }
+        if self.zoo is not None:
+            # Per-tenant blocks: version, residency, loads, and each
+            # tenant's own queue stats incl. its shed tallies — the
+            # operator-facing half of tenant isolation (SERVING.md §12).
+            out["zoo"] = self.zoo.healthz()
+            out["cache"] = (
+                None if self.zoo.cache is None else self.zoo.cache.stats()
+            )
+            return out
+        out["batcher"] = self.batcher.stats()
+        out["cache"] = (
+            None if self.batcher.cache is None
+            else self.batcher.cache.stats()
+        )
         try:
             entry = self.registry.peek()
             runner = entry.runner
@@ -496,11 +598,18 @@ class ServingServer(JsonHTTPFront):
                 self.registry.versions()
                 if hasattr(self.registry, "versions") else []
             ),
+            # Per-tenant control-plane state (versions, residency, quota
+            # lanes, shed tallies) when a zoo backs this server.
+            "zoo": None if self.zoo is None else self.zoo.varz(),
             # Hit rate + occupancy of the serve score cache (None when
             # disabled) — the level-2 half of docs/PERFORMANCE.md §10.
+            # Zoo-backed servers share ONE tenant-partitioned cache.
             "cache": (
-                None if self.batcher.cache is None
-                else self.batcher.cache.stats()
+                (None if self.zoo.cache is None
+                 else self.zoo.cache.stats())
+                if self.zoo is not None else
+                (None if self.batcher.cache is None
+                 else self.batcher.cache.stats())
             ),
             # The audited effective config: every LANGDETECT_* knob's live
             # value and provenance (explicit/env/profile/default), plus

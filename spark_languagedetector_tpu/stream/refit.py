@@ -78,6 +78,7 @@ class AutoRefit:
         final_refit: bool = True,
         prewarm: bool = True,
         source_name: str = "auto-refit",
+        tenant: str | None = None,
     ):
         from ..models.refit import FitAccumulator
 
@@ -89,6 +90,12 @@ class AutoRefit:
         self.final_refit = final_refit
         self.prewarm = prewarm
         self.source_name = source_name
+        # Tenant-scoped refit (docs/SERVING.md §12): the model zoo hands
+        # this driver ONE tenant's registry (via its install proxy), so a
+        # refit can only ever move that tenant's serving pointer; the
+        # tenant rides the swap metadata/log so /varz says WHOSE corpus a
+        # version was finalized from.
+        self.tenant = tenant
         self.progress = RefitProgress()
         self.last_model = None
         self._since_refit_batches = 0
@@ -177,14 +184,17 @@ class AutoRefit:
         REGISTRY.incr("refit/refits")
         version = None
         if self.registry is not None:
+            metadata = {
+                "refit_token": self.acc.committed,
+                "docs_seen": self.acc.docs_seen,
+            }
+            if self.tenant is not None:
+                metadata["tenant"] = self.tenant
             version = self.registry.install(
                 model,
                 prewarm=self.prewarm,
                 source=f"{self.source_name}:{self.acc.committed}",
-                metadata={
-                    "refit_token": self.acc.committed,
-                    "docs_seen": self.acc.docs_seen,
-                },
+                metadata=metadata,
             )
         with self.progress._lock:
             self.progress.refits += 1
@@ -192,7 +202,7 @@ class AutoRefit:
             self.progress.last_refit_docs = self.acc.docs_seen
         log_event(
             _log, "refit.swap", version=version, docs=self.acc.docs_seen,
-            token=self.acc.committed,
+            token=self.acc.committed, tenant=self.tenant,
         )
         return version
 

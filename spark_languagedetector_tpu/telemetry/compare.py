@@ -76,7 +76,7 @@ _TRACKED_GAUGES = {
 # contract checker (analysis/, R2) verifies every row is actually
 # emitted somewhere — a misspelled or retired counter fails tier-1
 # instead of silently never regressing.
-_RELIABILITY_COUNTER_PREFIXES = ("resilience/", "serve/shed")
+_RELIABILITY_COUNTER_PREFIXES = ("resilience/", "serve/shed", "zoo/shed")
 _RELIABILITY_COUNTERS = (
     "score/retries",
     "stream/retries",
@@ -87,6 +87,21 @@ _RELIABILITY_COUNTERS = (
     "fleet/ejections",
     "fleet/shed_requests",
     "fleet/swap_aborts",
+    # Multi-tenant zoo (docs/SERVING.md §12): a cross-tenant routing
+    # reject or a failed tenant cold load appearing against a clean
+    # baseline is an isolation/availability regression, full stop.
+    "zoo/cross_tenant_rejects",
+    "zoo/load_errors",
+)
+
+# Informational counters: diffed and shown like the reliability set but
+# NEVER a regression — evictions and cold loads are normal life under a
+# residency budget (a bigger tenant population legitimately pages more),
+# so their movement is operator signal, not a gate. The static contract
+# checker (analysis/, R2) still verifies every row is emitted somewhere.
+_INFORMATIONAL_COUNTERS = (
+    "zoo/evictions",
+    "zoo/cold_loads",
 )
 
 _TRACKED_RATIOS = {
@@ -249,6 +264,7 @@ def capture_stats(events: list[dict]) -> dict:
                 and (
                     str(k).startswith(_RELIABILITY_COUNTER_PREFIXES)
                     or str(k) in _RELIABILITY_COUNTERS
+                    or str(k) in _INFORMATIONAL_COUNTERS
                 )
             }
     return {
@@ -348,6 +364,15 @@ def compare_captures(
             # any appearance regresses regardless of threshold.
             delta = math.inf
             shown = f"{'new':>8}"
+        if name in _INFORMATIONAL_COUNTERS:
+            # Tracked for the operator, exempt from the gate: paging
+            # activity moving with the tenant population is expected.
+            if delta == math.inf or abs(delta) > threshold / 2:
+                lines.append(
+                    f"{name:<28} {'count':<14} {bv:>12.6f} "
+                    f"{nv:>12.6f} {shown}  informational"
+                )
+            continue
         flag = ""
         if delta > threshold:
             flag = "  REGRESSION"

@@ -1,0 +1,46 @@
+"""Multi-tenant model zoo: named tenants, bounded residency, isolation.
+
+The control plane that turns the single-model serving stack into a
+many-profile one (docs/SERVING.md §12):
+
+  * :class:`~.zoo.ModelZoo` — tenant → versioned registry + dedicated
+    batcher routing, tenant-scoped installs/rollbacks, and per-tenant
+    auto-refit scoping;
+  * :class:`~.residency.ResidencyManager` — LRU paging of resident
+    weight tables under the ``LANGDETECT_ZOO_RESIDENT_BYTES`` /
+    ``LANGDETECT_ZOO_RESIDENT_MODELS`` budgets, never evicting a leased
+    version;
+  * :class:`~.zoo.TenantQuota` — per-tenant admission-queue overrides
+    (the quota lane that keeps a noisy tenant's burst on that tenant);
+  * :class:`~.zoo.TenantLoadShed` / :class:`~.zoo.UnknownTenant` — the
+    explicit per-tenant failure surface (503 + Retry-After / 400).
+
+Importing this package never initializes jax — runners are built lazily
+by the models each tenant's cold load installs.
+"""
+
+from __future__ import annotations
+
+from .residency import ResidencyManager
+from .zoo import (
+    DEFAULT_TENANT,
+    ModelZoo,
+    TenantEntry,
+    TenantLoadShed,
+    TenantQuota,
+    TenantRuntime,
+    UnknownTenant,
+    ZooError,
+)
+
+__all__ = [
+    "DEFAULT_TENANT",
+    "ModelZoo",
+    "ResidencyManager",
+    "TenantEntry",
+    "TenantLoadShed",
+    "TenantQuota",
+    "TenantRuntime",
+    "UnknownTenant",
+    "ZooError",
+]

@@ -1,0 +1,147 @@
+"""LRU residency accounting for the model zoo (docs/SERVING.md §12).
+
+Hundreds of tenant profiles cannot all keep their quantized weight tables
+(and compiled-program handles) resident at once; this module owns the
+bookkeeping half of paging: which tenants are resident, how many table
+bytes each one holds, and — when a new load pushes the zoo past its byte
+or model budget — which least-recently-used tenants to page out.
+
+Policy, not mechanism: the :class:`~.zoo.ModelZoo` supplies ``evictable``
+(a tenant is untouchable while any of its registry versions holds a lease
+or its batcher has queued/in-flight work — "evictions never touch a
+leased version" is structural, via :meth:`~..serve.registry.ModelRegistry
+.busy`) and ``evict`` (the actual teardown). When every candidate is
+busy, the zoo runs transiently over budget rather than blocking or
+corrupting a dispatch — logged, never silent.
+
+Budgets resolve through ``exec/config``'s audited table
+(``LANGDETECT_ZOO_RESIDENT_BYTES`` / ``LANGDETECT_ZOO_RESIDENT_MODELS``;
+unset ⇒ unlimited). Occupancy is surfaced as the
+``langdetect_zoo_resident_bytes`` / ``langdetect_zoo_resident_models``
+gauges and every page-out increments ``zoo/evictions`` (tracked
+informationally by ``telemetry/compare`` — evictions are normal life
+under a budget, not a regression).
+
+Not thread-safe on its own: the owning zoo calls every method under its
+control-plane lock.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable
+
+from ..exec import config as exec_config
+from ..telemetry import REGISTRY
+from ..utils.logging import get_logger, log_event
+
+_log = get_logger("zoo.residency")
+
+
+class ResidencyManager:
+    """LRU map of resident tenants → table bytes, under two budgets."""
+
+    def __init__(
+        self,
+        *,
+        max_bytes: int | None = None,
+        max_models: int | None = None,
+    ):
+        mb = exec_config.resolve("zoo_resident_bytes", max_bytes)
+        mm = exec_config.resolve("zoo_resident_models", max_models)
+        self.max_bytes = None if mb is None else int(mb)
+        self.max_models = None if mm is None else int(mm)
+        self._resident: OrderedDict[str, int] = OrderedDict()
+
+    # ------------------------------------------------------------ access ----
+    @property
+    def bytes(self) -> int:
+        return sum(self._resident.values())
+
+    @property
+    def models(self) -> int:
+        return len(self._resident)
+
+    def resident(self) -> dict[str, int]:
+        """{tenant: table bytes} in LRU order (oldest first)."""
+        return dict(self._resident)
+
+    def is_resident(self, name: str) -> bool:
+        return name in self._resident
+
+    # ----------------------------------------------------------- updates ----
+    def touch(self, name: str) -> None:
+        """Mark one resident tenant most-recently-used."""
+        if name in self._resident:
+            self._resident.move_to_end(name)
+
+    def drop(self, name: str) -> None:
+        """Forget a tenant the zoo tore down outside the admit loop."""
+        if self._resident.pop(name, None) is not None:
+            self._gauges()
+
+    def _over_budget(self) -> bool:
+        if self.max_models is not None and self.models > self.max_models:
+            return True
+        return self.max_bytes is not None and self.bytes > self.max_bytes
+
+    def admit(
+        self,
+        name: str,
+        nbytes: int,
+        *,
+        evictable: Callable[[str], bool],
+        evict: Callable[[str], None],
+    ) -> list[str]:
+        """Record ``name`` resident at ``nbytes`` (MRU), then page out
+        LRU tenants while over either budget. The just-admitted tenant is
+        never its own victim; an unevictable candidate (leased / queued
+        work) is skipped. Returns the evicted tenant names in order."""
+        self._resident.pop(name, None)
+        self._resident[name] = int(nbytes)
+        evicted: list[str] = []
+        while self._over_budget():
+            victim = next(
+                (
+                    n for n in self._resident
+                    if n != name and n not in evicted and evictable(n)
+                ),
+                None,
+            )
+            if victim is None:
+                # Every candidate is mid-dispatch or leased: run over
+                # budget until the next admit rather than evicting under
+                # a live lease or blocking the serving path.
+                log_event(
+                    _log, "zoo.residency.over_budget", tenant=name,
+                    resident_bytes=self.bytes, resident_models=self.models,
+                    max_bytes=self.max_bytes, max_models=self.max_models,
+                )
+                break
+            evict(victim)
+            del self._resident[victim]
+            evicted.append(victim)
+            REGISTRY.incr("zoo/evictions")
+            log_event(
+                _log, "zoo.residency.evicted", tenant=victim, for_=name,
+                resident_bytes=self.bytes, resident_models=self.models,
+            )
+        self._gauges()
+        return evicted
+
+    def _gauges(self) -> None:
+        REGISTRY.set_gauge(
+            "langdetect_zoo_resident_bytes", float(self.bytes)
+        )
+        REGISTRY.set_gauge(
+            "langdetect_zoo_resident_models", float(self.models)
+        )
+
+    def describe(self) -> dict:
+        return {
+            "resident_models": self.models,
+            "resident_bytes": self.bytes,
+            "max_models": self.max_models,
+            "max_bytes": self.max_bytes,
+            "lru": list(self._resident),
+        }

@@ -1,0 +1,651 @@
+"""Multi-tenant model zoo: hundreds of profiles behind one serving fleet.
+
+The serving stack below this module is single-model: one
+:class:`~..serve.registry.ModelRegistry`, one
+:class:`~..serve.batcher.ContinuousBatcher`, one set of knobs. Serving
+millions of users means many *domains* — per-customer, per-script,
+per-domain profile variants — and GSPMD / pjit portability (PAPERS.md:
+arXiv:2105.04663, arXiv:2204.06514) makes that a pure control-plane
+problem: every tenant's compiled program is the same geometry-portable
+artifact, so multi-tenancy is routing + residency + isolation, which is
+exactly what this module owns (docs/SERVING.md §12):
+
+  * **Tenant routing** — a named map tenant → versioned registry +
+    dedicated batcher. ``runtime(None)`` resolves the default tenant, so
+    every pre-zoo single-model call keeps its exact behavior.
+  * **Bounded residency** — tenants page in on first use (cold load:
+    the registry's ``prepare``/``commit`` split, so the build + pre-warm
+    happen off the serving path and the pointer flip is the only
+    serving-visible step) and page out LRU under the
+    ``LANGDETECT_ZOO_RESIDENT_BYTES`` / ``_MODELS`` budgets
+    (:mod:`.residency`). Eviction drops the compiled runner and device
+    tables — and, for disk-backed tenants with no unsaved installs, the
+    host-side model too — but never touches a tenant whose registry
+    holds a lease or whose batcher has queued work.
+  * **Isolation** — each tenant's batcher is its own admission queue
+    (its own quota lane: a noisy tenant's burst fills and sheds *that*
+    queue, with per-queue shed tallies and a ``zoo/shed/<tenant>``
+    counter — neighbors never pay), and the shared score cache is
+    partitioned per tenant by key prefix. A bookkeeping mismatch between
+    the requested tenant and the runtime that would answer is rejected
+    and counted (``zoo/cross_tenant_rejects`` — a reliability counter
+    whose very appearance regresses the compare guard).
+  * **Tenant-scoped refit** — :meth:`ModelZoo.auto_refit` hands the
+    continuous-learning driver an install proxy bound to ONE tenant, so
+    a refit can only ever move that tenant's serving pointer.
+
+A cold-load failure (including an injected ``zoo/load`` fault) degrades
+to :class:`TenantLoadShed` — HTTP 503 + Retry-After *for that tenant
+only*, never a wrong-tenant answer and never an outage for its
+neighbors.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from dataclasses import dataclass
+
+from ..resilience import faults
+from ..serve.batcher import (
+    ContinuousBatcher,
+    ServeClosed,
+    ServeError,
+    ServeOverloaded,
+)
+from ..serve.registry import ModelRegistry
+from ..telemetry import REGISTRY
+from ..utils.logging import get_logger, log_event
+from .residency import ResidencyManager
+
+_log = get_logger("zoo.zoo")
+
+# Tenant names ride metric names (`zoo/shed/<tenant>`), cache-key scopes,
+# and log fields: keep them in the same lowercase grammar as every other
+# telemetry segment so the observability surface stays parseable.
+_TENANT_RE = re.compile(r"[a-z0-9_]{1,64}")
+
+_VERSION_RE = re.compile(r"v(\d+)")
+
+DEFAULT_TENANT = "default"
+
+
+class ZooError(ServeError):
+    """Base class for model-zoo control-plane failures."""
+
+
+class UnknownTenant(ZooError, ValueError):
+    """Request named a tenant the zoo does not know (a ValueError, so the
+    HTTP front end answers 400 — a caller bug, never retried)."""
+
+
+class TenantLoadShed(ServeOverloaded):
+    """A tenant's cold load failed (injected ``zoo/load`` fault, bad
+    model directory, OOM): that tenant's request is shed explicitly —
+    HTTP 503 + Retry-After — and every other tenant keeps serving."""
+
+    def __init__(self, tenant: str, *, retry_after_s: float):
+        super().__init__(
+            f"tenant {tenant!r} cold load failed; retry shortly",
+            reason="cold_load",
+            retry_after_s=retry_after_s,
+        )
+        self.tenant = tenant
+
+
+@dataclass(frozen=True)
+class TenantQuota:
+    """Per-tenant overrides for the tenant's admission queue (its quota
+    lane). ``None`` fields fall through to the zoo-wide batcher defaults
+    (which resolve env > tuning profile > built-in like every knob)."""
+
+    max_rows: int | None = None
+    max_wait_ms: float | None = None
+    max_queue_rows: int | None = None
+    slo_ms: float | None = None
+
+    def describe(self) -> dict:
+        return {
+            "max_rows": self.max_rows,
+            "max_wait_ms": self.max_wait_ms,
+            "max_queue_rows": self.max_queue_rows,
+            "slo_ms": self.slo_ms,
+        }
+
+
+class TenantRuntime:
+    """One resident tenant's serving half: registry + batcher + cost."""
+
+    __slots__ = ("tenant", "registry", "batcher", "table_bytes", "loaded_at")
+
+    def __init__(self, tenant, registry, batcher, table_bytes):
+        self.tenant = tenant
+        self.registry = registry
+        self.batcher = batcher
+        self.table_bytes = int(table_bytes)
+        self.loaded_at = time.time()
+
+
+class TenantEntry:
+    """One registered tenant: identity, current model/version, quota, and
+    (while resident) its runtime."""
+
+    __slots__ = (
+        "name", "model", "version", "seq", "source", "quota", "dirty",
+        "loads", "runtime", "_load_lock",
+    )
+
+    def __init__(self, name, model, version, seq, source, quota):
+        self.name = name
+        self.model = model
+        self.version = version
+        self.seq = seq
+        self.source = source
+        self.quota = quota or TenantQuota()
+        # True once an in-memory install (refit/admin swap by object)
+        # diverged this tenant from its on-disk source: eviction must
+        # then keep the host-side model (nothing on disk has it).
+        self.dirty = source is None
+        self.loads = 0
+        self.runtime: TenantRuntime | None = None
+        self._load_lock = threading.Lock()
+
+    def describe(self) -> dict:
+        rt = self.runtime
+        return {
+            "tenant": self.name,
+            "version": self.version,
+            "resident": rt is not None,
+            "loads": self.loads,
+            "source": self.source,
+            "dirty": self.dirty,
+            "table_bytes": rt.table_bytes if rt is not None else None,
+            "quota": self.quota.describe(),
+        }
+
+
+def _table_bytes(runner) -> int:
+    """Resident cost of one tenant's device tables: the (possibly
+    quantized) weight table plus whichever membership form the profile
+    chose (dense LUT or cuckoo arrays)."""
+    total = 0
+    for attr in ("weights", "lut"):
+        nb = getattr(getattr(runner, attr, None), "nbytes", None)
+        if nb:
+            total += int(nb)
+    cuckoo = getattr(runner, "cuckoo", None)
+    if cuckoo is not None:
+        for attr in ("slots", "keys_lo", "keys_hi"):
+            nb = getattr(getattr(cuckoo, attr, None), "nbytes", None)
+            if nb:
+                total += int(nb)
+    return total
+
+
+class _TenantInstaller:
+    """Registry-shaped install proxy bound to one tenant: the only
+    surface :class:`~..stream.refit.AutoRefit` needs, routed through
+    :meth:`ModelZoo.install` so a refit lands on the tenant's *current*
+    registry even across an eviction/reload cycle — and can never land
+    anywhere else."""
+
+    def __init__(self, zoo: "ModelZoo", tenant: str):
+        self._zoo = zoo
+        self._tenant = tenant
+
+    def install(self, model, **kw) -> str:
+        return self._zoo.install(self._tenant, model, **kw)
+
+
+class ModelZoo:
+    """Named-tenant control plane in front of the serving stack.
+
+    ``batcher_kw`` are zoo-wide defaults for every tenant's
+    :class:`~..serve.batcher.ContinuousBatcher` (a tenant's
+    :class:`TenantQuota` overrides them per lane knob). One score cache
+    is shared across all tenants — entries are tenant-partitioned by key
+    prefix, so sharing is a memory win, never a leak (pinned by
+    ``tests/test_cache.py``).
+    """
+
+    def __init__(
+        self,
+        *,
+        default_tenant: str = DEFAULT_TENANT,
+        resident_bytes: int | None = None,
+        resident_models: int | None = None,
+        prewarm: bool = True,
+        cache=None,
+        cache_enable: bool | None = None,
+        retry_after_s: float = 0.25,
+        drain_timeout_s: float = 5.0,
+        **batcher_kw,
+    ):
+        from ..exec import config as exec_config
+
+        self.default_tenant = self._valid_name(default_tenant)
+        self.prewarm = prewarm
+        self.retry_after_s = float(retry_after_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self._batcher_kw = dict(batcher_kw)
+        if cache is None and bool(
+            exec_config.resolve("cache_enable", cache_enable)
+        ):
+            from ..serve.cache import ScoreCache
+
+            cache = ScoreCache()
+        self.cache = cache
+        self._entries: dict[str, TenantEntry] = {}
+        self._residency = ResidencyManager(
+            max_bytes=resident_bytes, max_models=resident_models
+        )
+        self._lock = threading.Lock()
+        # Runtimes detached by _evict_locked, awaiting their (possibly
+        # slow) drain — torn down by _finish_evictions AFTER the
+        # control-plane lock drops, so a page-out never stalls routing.
+        self._evicting: list[TenantRuntime] = []
+        self._closed = False
+        log_event(
+            _log, "zoo.start", default_tenant=self.default_tenant,
+            max_bytes=self._residency.max_bytes,
+            max_models=self._residency.max_models,
+        )
+
+    # ------------------------------------------------------- registration ---
+    @staticmethod
+    def _valid_name(name) -> str:
+        if not isinstance(name, str) or not _TENANT_RE.fullmatch(name):
+            raise UnknownTenant(
+                f"tenant names are [a-z0-9_]{{1,64}}, got {name!r}"
+            )
+        return name
+
+    def add_tenant(
+        self,
+        name: str,
+        model=None,
+        *,
+        path: str | None = None,
+        version: str = "v1",
+        quota: TenantQuota | None = None,
+        resident: bool = False,
+    ) -> TenantEntry:
+        """Register a tenant from a fitted model object or a persisted
+        model directory (``path`` tenants page fully to disk: eviction
+        can drop even the host-side model and reload it cold). Nothing
+        is built until the tenant's first request — or now, with
+        ``resident=True`` (pre-warming off the serving path)."""
+        name = self._valid_name(name)
+        if (model is None) == (path is None):
+            raise ValueError("pass exactly one of model or path")
+        m = _VERSION_RE.fullmatch(version)
+        seq = int(m.group(1)) if m else 1
+        entry = TenantEntry(name, model, version, seq, path, quota)
+        with self._lock:
+            if self._closed:
+                raise ZooError("model zoo is closed")
+            if name in self._entries:
+                raise ValueError(f"tenant {name!r} already registered")
+            self._entries[name] = entry
+        REGISTRY.incr("zoo/tenants_added")
+        log_event(
+            _log, "zoo.tenant_added", tenant=name, version=version,
+            source=path, resident=resident,
+        )
+        if resident:
+            self._load(entry)
+        return entry
+
+    def tenants(self) -> list[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def version(self, tenant: str | None = None) -> str:
+        return self._entry(tenant).version
+
+    def _entry(self, tenant: str | None) -> TenantEntry:
+        name = self.default_tenant if tenant is None else tenant
+        if not isinstance(name, str):
+            raise UnknownTenant(f'"tenant" must be a string, got {name!r}')
+        with self._lock:
+            entry = self._entries.get(name)
+        if entry is None:
+            raise UnknownTenant(f"unknown tenant {name!r}")
+        return entry
+
+    # ------------------------------------------------------------ routing ---
+    def runtime(self, tenant: str | None = None) -> tuple[TenantEntry, TenantRuntime]:
+        """Resolve a request's tenant (None ⇒ the default tenant) to its
+        live runtime, cold-loading if paged out. The returned runtime is
+        guaranteed to BE the named tenant's — a bookkeeping mismatch is
+        rejected and counted (``zoo/cross_tenant_rejects``), never
+        answered from the wrong model."""
+        entry = self._entry(tenant)
+        with self._lock:
+            rt = entry.runtime
+            if rt is not None:
+                self._guard_tenant(entry, rt)
+                self._residency.touch(entry.name)
+                return entry, rt
+        rt = self._load(entry)
+        return entry, rt
+
+    @staticmethod
+    def _guard_tenant(entry: TenantEntry, rt: TenantRuntime) -> None:
+        if rt.tenant != entry.name:
+            REGISTRY.incr("zoo/cross_tenant_rejects")
+            log_event(
+                _log, "zoo.cross_tenant_reject", tenant=entry.name,
+                runtime=rt.tenant,
+            )
+            raise ZooError(
+                f"tenant {entry.name!r} resolved runtime {rt.tenant!r}; "
+                "rejecting rather than answering from the wrong tenant"
+            )
+
+    # ---------------------------------------------------------- cold load ---
+    def _load(self, entry: TenantEntry) -> TenantRuntime:
+        """Page one tenant in: build + pre-warm its runner entirely off
+        the serving path (the registry ``prepare``/``commit`` split),
+        publish the runtime, then page out LRU tenants over budget."""
+        with entry._load_lock:
+            return self._load_locked(entry)
+
+    def _load_locked(self, entry: TenantEntry) -> TenantRuntime:
+        """:meth:`_load` body; the caller holds ``entry._load_lock``."""
+        with self._lock:
+            if self._closed:
+                # ServeClosed, not ZooError: a request racing server
+                # shutdown must surface as the retryable 503 the rest
+                # of the serving stack speaks, never a 500.
+                raise ServeClosed("model zoo is closed")
+            rt = entry.runtime
+            if rt is not None:  # raced: another caller loaded it
+                self._guard_tenant(entry, rt)
+                self._residency.touch(entry.name)
+                return rt
+        t0 = time.perf_counter()
+        try:
+            faults.inject("zoo/load")
+            model = entry.model
+            if model is None:
+                from ..models.estimator import LanguageDetectorModel
+
+                model = LanguageDetectorModel.load(entry.source)
+            registry = ModelRegistry(
+                drain_timeout_s=self.drain_timeout_s
+            )
+            prepared = registry.prepare(
+                model, version=entry.version, prewarm=self.prewarm,
+                source=entry.source, metadata={"tenant": entry.name},
+            )
+            registry.commit(prepared)
+        except Exception as e:
+            REGISTRY.incr("zoo/load_errors")
+            log_event(
+                _log, "zoo.load_failed", tenant=entry.name,
+                error=repr(e),
+            )
+            raise TenantLoadShed(
+                entry.name, retry_after_s=self.retry_after_s
+            ) from e
+        batcher = self._make_batcher(entry, registry)
+        rt = TenantRuntime(
+            entry.name, registry, batcher,
+            _table_bytes(prepared.runner),
+        )
+        with self._lock:
+            entry.model = model
+            entry.runtime = rt
+            entry.loads += 1
+            evicted = self._residency.admit(
+                entry.name, rt.table_bytes,
+                evictable=self._evictable_locked,
+                evict=self._evict_locked,
+            )
+        self._finish_evictions()
+        REGISTRY.incr("zoo/cold_loads")
+        log_event(
+            _log, "zoo.cold_load", tenant=entry.name,
+            version=entry.version, loads=entry.loads,
+            table_bytes=rt.table_bytes, evicted=evicted,
+            wall_ms=round((time.perf_counter() - t0) * 1e3, 3),
+        )
+        return rt
+
+    def _make_batcher(self, entry: TenantEntry, registry) -> ContinuousBatcher:
+        q = entry.quota
+        kw = dict(self._batcher_kw)
+        for knob, value in (
+            ("max_rows", q.max_rows),
+            ("max_wait_ms", q.max_wait_ms),
+            ("max_queue_rows", q.max_queue_rows),
+            ("slo_ms", q.slo_ms),
+        ):
+            if value is not None:
+                kw[knob] = value
+        # The shared cache is passed explicitly (tenant-partitioned by
+        # the batcher's key scope); cache_enable=False keeps a cache-less
+        # zoo from growing one private cache per tenant.
+        return ContinuousBatcher(
+            registry, cache=self.cache, cache_enable=False,
+            tenant=entry.name, name=f"zoo-{entry.name}", **kw,
+        )
+
+    # ------------------------------------------------------------ paging ----
+    def _evictable_locked(self, name: str) -> bool:
+        entry = self._entries.get(name)
+        rt = entry.runtime if entry is not None else None
+        if rt is None:
+            return False
+        stats = rt.batcher.stats()
+        if stats["queued_rows"] or stats["inflight_rows"]:
+            return False
+        return not rt.registry.busy()
+
+    def _evict_locked(self, name: str) -> None:
+        """Detach one tenant under the control-plane lock (cheap
+        pointer work only). The batcher drain — which can run a whole
+        raced-in dispatch — happens in :meth:`_finish_evictions` after
+        the lock drops, so one page-out never stalls every other
+        tenant's routing."""
+        entry = self._entries[name]
+        rt = entry.runtime
+        entry.runtime = None
+        if rt is None:
+            return
+        self._evicting.append(rt)
+        model = entry.model
+        if model is not None and hasattr(model, "_runner"):
+            # The registry's runner refs die with rt; the model's cached
+            # runner is the last pin on the device tables.
+            model._runner = None
+        if entry.source is not None and not entry.dirty:
+            entry.model = None  # disk-backed and clean: page out fully
+
+    def _finish_evictions(self) -> None:
+        """Drain + tear down detached runtimes outside the zoo lock
+        (idle by the evictable check; the drain still answers — never
+        drops — an admit that raced the detach)."""
+        while True:
+            with self._lock:
+                if not self._evicting:
+                    return
+                rt = self._evicting.pop()
+            rt.batcher.close(drain=True)
+
+    def preload(self, tenants=None) -> list[str]:
+        """Make the named tenants (default: all) resident ahead of
+        traffic — the operator-facing pre-warm, off the serving path."""
+        names = list(tenants) if tenants is not None else self.tenants()
+        loaded = []
+        for name in names:
+            entry = self._entry(name)
+            if entry.runtime is None:
+                self._load(entry)
+                loaded.append(name)
+        return loaded
+
+    def resident(self) -> dict[str, int]:
+        with self._lock:
+            return self._residency.resident()
+
+    # ----------------------------------------------------------- installs ---
+    def install(
+        self,
+        tenant: str | None,
+        model,
+        *,
+        version: str | None = None,
+        prewarm: bool | None = None,
+        source: str | None = None,
+        from_path: str | None = None,
+        metadata: dict | None = None,
+    ) -> str:
+        """Tenant-scoped hot-swap: install ``model`` as the tenant's new
+        serving version. A resident tenant goes through its registry's
+        pre-warmed atomic flip; a paged-out tenant just updates its
+        paged state (the next cold load builds the new version
+        directly). No other tenant's pointer moves.
+
+        ``source`` is provenance (registry metadata); ``from_path``
+        additionally asserts the model is bit-identical to that saved
+        directory, so eviction may page the tenant fully back to disk.
+        An in-memory install (refit) clears the on-disk source — the old
+        path no longer describes what this tenant serves."""
+        entry = self._entry(tenant)
+        with entry._load_lock:
+            seq = entry.seq + 1
+            vname = version or f"v{seq}"
+            meta = dict(metadata or {})
+            meta.setdefault("tenant", entry.name)
+            with self._lock:
+                rt = entry.runtime
+            if rt is not None:
+                vname = rt.registry.install(
+                    model,
+                    version=vname,
+                    prewarm=self.prewarm if prewarm is None else prewarm,
+                    source=source,
+                    metadata=meta,
+                )
+            with self._lock:
+                entry.model = model
+                entry.version = vname
+                entry.source = from_path
+                entry.dirty = from_path is None
+                m = _VERSION_RE.fullmatch(vname)
+                entry.seq = max(entry.seq, int(m.group(1))) if m else seq
+                if rt is not None and entry.runtime is rt:
+                    rt.table_bytes = _table_bytes(
+                        rt.registry.peek().runner
+                    )
+                    self._residency.admit(
+                        entry.name, rt.table_bytes,
+                        evictable=self._evictable_locked,
+                        evict=self._evict_locked,
+                    )
+            self._finish_evictions()
+        REGISTRY.incr("zoo/installs")
+        log_event(
+            _log, "zoo.install", tenant=entry.name, version=vname,
+            resident=rt is not None, source=source,
+        )
+        return vname
+
+    def load(
+        self, tenant: str | None, path: str, *, version: str | None = None
+    ) -> str:
+        """Install-from-disk for one tenant (the zoo's ``/admin/swap``)."""
+        from ..models.estimator import LanguageDetectorModel
+
+        return self.install(
+            tenant, LanguageDetectorModel.load(path),
+            version=version, source=str(path), from_path=str(path),
+        )
+
+    def rollback(self, tenant: str | None = None) -> str:
+        """Tenant-scoped rollback through the tenant's live registry
+        (requires residency: history does not survive paging). Serialized
+        against installs/loads on the same tenant, and the paged state is
+        resynced from the registry — model AND version — so an eviction
+        right after a rollback reloads exactly what the registry served."""
+        entry = self._entry(tenant)
+        with entry._load_lock:
+            with self._lock:
+                rt = entry.runtime
+            if rt is None:
+                rt = self._load_locked(entry)
+            version = rt.registry.rollback()
+            served = rt.registry.peek()
+            with self._lock:
+                entry.version = version
+                entry.model = served.model
+                entry.source = None
+                entry.dirty = True
+                m = _VERSION_RE.fullmatch(version)
+                if m:
+                    entry.seq = max(entry.seq, int(m.group(1)))
+        return version
+
+    def auto_refit(self, tenant: str | None, estimator, **kw):
+        """A continuous-learning driver scoped to ONE tenant: its
+        install proxy routes every refit hot-swap through
+        :meth:`install` for that tenant's registry only
+        (docs/SERVING.md §7a, §12)."""
+        from ..stream.refit import AutoRefit
+
+        entry = self._entry(tenant)
+        kw.setdefault("source_name", f"auto-refit:{entry.name}")
+        return AutoRefit(
+            estimator, _TenantInstaller(self, entry.name),
+            tenant=entry.name, **kw,
+        )
+
+    # ------------------------------------------------------------- status ---
+    def healthz(self) -> dict:
+        with self._lock:
+            entries = list(self._entries.values())
+            residency = self._residency.describe()
+        tenants = {}
+        for entry in entries:
+            block = entry.describe()
+            rt = entry.runtime
+            block["batcher"] = rt.batcher.stats() if rt is not None else None
+            tenants[entry.name] = block
+        return {
+            "default_tenant": self.default_tenant,
+            "tenants": tenants,
+            "residency": residency,
+        }
+
+    def varz(self) -> dict:
+        out = self.healthz()
+        out["cache"] = None if self.cache is None else self.cache.stats()
+        for name, block in out["tenants"].items():
+            entry = self._entries.get(name)
+            rt = entry.runtime if entry is not None else None
+            block["versions"] = (
+                rt.registry.versions() if rt is not None else None
+            )
+        return out
+
+    # ---------------------------------------------------------- lifecycle ---
+    def close(self, drain: bool = True) -> None:
+        """Tear down every resident tenant. With ``drain`` (default) no
+        accepted request is dropped; ``drain=False`` is the abrupt path —
+        queued requests fail explicitly with ServeClosed, never hang."""
+        with self._lock:
+            self._closed = True
+            names = list(self._entries)
+        for name in names:
+            entry = self._entries[name]
+            with entry._load_lock:
+                with self._lock:
+                    rt = entry.runtime
+                    entry.runtime = None
+                    self._residency.drop(name)
+                if rt is not None:
+                    rt.batcher.close(drain=drain)
+        log_event(_log, "zoo.close", tenants=len(names), drained=drain)

@@ -401,6 +401,63 @@ def test_shared_cache_does_not_leak_across_models():
     np.testing.assert_array_equal(a2.values, r2.score(docs))
 
 
+def test_shared_cache_does_not_leak_across_tenants():
+    """Tenant scope (ISSUE 14 satellite): two tenants sharing ONE
+    ScoreCache through the model zoo, with same-named versions ("v1"),
+    can never cross-answer — before and after an eviction/reload cycle.
+    The batcher's tenant prefix partitions the key space, and an evicted
+    tenant reloads into the SAME scope (tenant + model uid + version),
+    so its own warm entries stay valid while the neighbor's stay
+    unreachable."""
+    from spark_languagedetector_tpu import LanguageDetectorModel
+    from spark_languagedetector_tpu.zoo import ModelZoo
+
+    # Dedicated 1-gram models (256-row dense tables): runner builds are
+    # O(ms), and the zoo's eviction (which drops the cached runner) never
+    # touches the module's shared fleet-seed models.
+    def tiny_model(seed):
+        rng = np.random.default_rng(seed)
+        gram_map = {
+            bytes([b]): rng.random(2).tolist() for b in range(97, 123)
+        }
+        return LanguageDetectorModel.from_gram_map(gram_map, [1], ("x", "y"))
+
+    m1, m2 = tiny_model(31), tiny_model(32)
+    docs = texts_to_bytes(["abab", "zz", "bcbc"])
+    want1 = m1._get_runner().score(docs)
+    want2 = m2._get_runner().score(docs)
+    assert not np.array_equal(want1, want2)  # distinct, so leaks show
+    shared = ScoreCache(max_rows=256, max_bytes=1 << 20)
+    zoo = ModelZoo(
+        cache=shared, resident_models=1, max_wait_ms=2, max_rows=64,
+    )
+    zoo.add_tenant("ta", m1)
+    zoo.add_tenant("tb", m2)
+    try:
+        _, rta = zoo.runtime("ta")
+        a1 = rta.batcher.submit(docs).result()
+        assert a1.version == "v1"
+        np.testing.assert_array_equal(a1.values, want1)
+        # Same docs, same version name, other tenant: its own answer —
+        # and under a 1-model budget this load also evicts "ta".
+        _, rtb = zoo.runtime("tb")
+        a2 = rtb.batcher.submit(docs).result()
+        assert a2.version == "v1"
+        np.testing.assert_array_equal(a2.values, want2)
+        assert list(zoo.resident()) == ["tb"]
+        # Cold reload of "ta": same tenant scope ⇒ its prior entries are
+        # legal hits, the neighbor's remain structurally unreachable.
+        _, rta2 = zoo.runtime("ta")
+        a1b = rta2.batcher.submit(docs).result()
+        np.testing.assert_array_equal(a1b.values, want1)
+        _, rtb2 = zoo.runtime("tb")
+        a2b = rtb2.batcher.submit(docs).result()
+        np.testing.assert_array_equal(a2b.values, want2)
+        assert shared.stats()["hits"] >= len(docs)  # warm repeats hit
+    finally:
+        zoo.close()
+
+
 def test_segment_cache_does_not_leak_across_knobs_or_models():
     """Segment-mode cache-key completeness (ISSUE 12 satellite): the mode
     string carries every decode knob (k, reject threshold, cell, smooth,

@@ -1,0 +1,677 @@
+"""Multi-tenant model zoo (ISSUE 14, docs/SERVING.md §12).
+
+The acceptance contract: tenant routing answers every request from
+exactly the named tenant's model (no tenant ⇒ the default tenant,
+bit-identically to the single-model surface), residency stays under its
+budgets by paging LRU *idle* tenants (never a leased version) with cold
+reloads preserving per-tenant parity, a noisy tenant's burst sheds that
+tenant only, a failed cold load is a 503 + Retry-After for that tenant
+only (deterministic replay via the ``zoo/load`` fault site), and a
+tenant-scoped refit moves exactly one tenant's serving pointer.
+"""
+
+import itertools
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from spark_languagedetector_tpu import LanguageDetectorModel
+from spark_languagedetector_tpu.exec.core import AdmissionQueue
+from spark_languagedetector_tpu.ops.encoding import texts_to_bytes
+from spark_languagedetector_tpu.resilience import faults
+from spark_languagedetector_tpu.resilience.faults import FaultPlan
+from spark_languagedetector_tpu.serve.client import ServeClient, ServeHTTPError
+from spark_languagedetector_tpu.serve.server import ServingServer
+from spark_languagedetector_tpu.telemetry import REGISTRY
+from spark_languagedetector_tpu.zoo import (
+    ModelZoo,
+    TenantLoadShed,
+    TenantQuota,
+    UnknownTenant,
+    ZooError,
+)
+
+LANGS = ("l0", "l1", "l2")
+TEXTS = ["abab", "xyxy", "ddcc", "axdy"]
+
+
+def _model(seed: int) -> LanguageDetectorModel:
+    """A small, tenant-distinct model: same spec (one compile cache
+    entry across every tenant), seeded weights."""
+    rng = np.random.default_rng(seed)
+    gram_map = {
+        (a + b).encode(): rng.random(len(LANGS)).tolist()
+        for a, b in itertools.product("abcdxyz", repeat=2)
+    }
+    return LanguageDetectorModel.from_gram_map(gram_map, [2], LANGS)
+
+
+def _zoo(**kw) -> ModelZoo:
+    kw.setdefault("max_wait_ms", 2)
+    kw.setdefault("max_rows", 64)
+    return ModelZoo(**kw)
+
+
+def _labels(model, texts=TEXTS):
+    return model._get_runner().predict_ids(texts_to_bytes(texts))
+
+
+def _counter(name: str) -> int:
+    return int(REGISTRY.snapshot()["counters"].get(name, 0))
+
+
+# ------------------------------------------------------------- routing ------
+def test_zoo_routes_each_tenant_to_its_own_model():
+    zoo = _zoo()
+    models = {f"t{i}": _model(i) for i in range(3)}
+    for name, model in models.items():
+        zoo.add_tenant(name, model)
+    zoo.add_tenant("default", _model(9))
+    try:
+        for name, model in models.items():
+            entry, rt = zoo.runtime(name)
+            assert entry.name == name
+            got = rt.batcher.submit(
+                texts_to_bytes(TEXTS), want_labels=True
+            ).result()
+            np.testing.assert_array_equal(got.values, _labels(model))
+            assert got.version == "v1"
+        # No tenant ⇒ the default tenant.
+        entry, rt = zoo.runtime(None)
+        assert entry.name == "default"
+    finally:
+        zoo.close()
+
+
+def test_zoo_unknown_tenant_and_name_validation():
+    zoo = _zoo()
+    zoo.add_tenant("default", _model(0))
+    try:
+        with pytest.raises(UnknownTenant):
+            zoo.runtime("nope")
+        # UnknownTenant is a ValueError: the HTTP surface answers 400.
+        assert issubclass(UnknownTenant, ValueError)
+        for bad in ("Tenant", "a b", "", "x" * 65, 7):
+            with pytest.raises((UnknownTenant, ValueError)):
+                zoo.add_tenant(bad, _model(1))
+        with pytest.raises(ValueError):
+            zoo.add_tenant("default", _model(1))  # duplicate
+        with pytest.raises(ValueError):
+            zoo.add_tenant("both")  # neither model nor path
+    finally:
+        zoo.close()
+
+
+def test_cross_tenant_mapping_corruption_is_rejected_and_counted():
+    """The structural guard: a runtime that is not the named tenant's is
+    never allowed to answer — rejected + counted, so the compare guard
+    sees any appearance as a regression."""
+    zoo = _zoo()
+    zoo.add_tenant("a", _model(1))
+    zoo.add_tenant("b", _model(2))
+    try:
+        _, rt_b = zoo.runtime("b")
+        before = _counter("zoo/cross_tenant_rejects")
+        zoo._entries["a"].runtime = rt_b  # simulated bookkeeping bug
+        with pytest.raises(ZooError):
+            zoo.runtime("a")
+        assert _counter("zoo/cross_tenant_rejects") == before + 1
+        zoo._entries["a"].runtime = None
+    finally:
+        zoo.close()
+
+
+# ----------------------------------------------------------- residency ------
+def test_residency_budget_evicts_lru_and_cold_reload_keeps_parity():
+    REGISTRY.reset()
+    zoo = _zoo(resident_models=2)
+    models = {f"t{i}": _model(i) for i in range(4)}
+    for name, model in models.items():
+        zoo.add_tenant(name, model)
+    try:
+        docs = texts_to_bytes(TEXTS)
+        for name in ("t0", "t1", "t2", "t3"):
+            zoo.runtime(name)
+        # LRU under a 2-model budget: only the last two stay resident.
+        assert list(zoo.resident()) == ["t2", "t3"]
+        assert _counter("zoo/evictions") == 2
+        # Cold reload of an evicted tenant: parity preserved, version
+        # preserved, loads counted.
+        entry, rt = zoo.runtime("t0")
+        assert entry.loads == 2 and entry.version == "v1"
+        got = rt.batcher.submit(docs, want_labels=True).result().values
+        np.testing.assert_array_equal(got, _labels(models["t0"]))
+        assert _counter("zoo/cold_loads") == 5
+        gauges = REGISTRY.snapshot()["gauges"]
+        assert gauges["langdetect_zoo_resident_models"][""] == 2.0
+        assert gauges["langdetect_zoo_resident_bytes"][""] > 0
+    finally:
+        zoo.close()
+
+
+def test_byte_budget_evicts_and_single_tenant_may_exceed_it():
+    one = _model(0)
+    bytes_of_one = None
+    zoo = _zoo()
+    zoo.add_tenant("probe", one)
+    try:
+        _, rt = zoo.runtime("probe")
+        bytes_of_one = rt.table_bytes
+    finally:
+        zoo.close()
+    assert bytes_of_one and bytes_of_one > 0
+    # Budget fits ~1.5 models: the second admit must evict the first;
+    # a single over-budget tenant still serves (transient over-budget
+    # beats an unservable tenant).
+    zoo = _zoo(resident_bytes=int(bytes_of_one * 1.5))
+    zoo.add_tenant("a", _model(1))
+    zoo.add_tenant("b", _model(2))
+    try:
+        zoo.runtime("a")
+        zoo.runtime("b")
+        assert list(zoo.resident()) == ["b"]
+        zoo.runtime("a")
+        assert list(zoo.resident()) == ["a"]
+    finally:
+        zoo.close()
+
+
+def test_eviction_never_touches_a_leased_version():
+    """A held lease pins its tenant: the LRU must skip it (transiently
+    over budget) and evict it only after release."""
+    zoo = _zoo(resident_models=1)
+    zoo.add_tenant("leased", _model(1))
+    zoo.add_tenant("other", _model(2))
+    try:
+        _, rt = zoo.runtime("leased")
+        with rt.registry.lease():
+            zoo.runtime("other")
+            # Over budget, but the leased tenant stayed resident.
+            assert set(zoo.resident()) == {"leased", "other"}
+            assert zoo._entries["leased"].runtime is rt
+        # Lease released: the next admit can finally page it out.
+        zoo.add_tenant("third", _model(3))
+        zoo.runtime("third")
+        assert "leased" not in zoo.resident()
+    finally:
+        zoo.close()
+
+
+def test_preload_warms_tenants_off_the_serving_path():
+    zoo = _zoo()
+    for i in range(3):
+        zoo.add_tenant(f"t{i}", _model(i))
+    try:
+        assert zoo.resident() == {}
+        loaded = zoo.preload()
+        assert loaded == ["t0", "t1", "t2"]
+        assert set(zoo.resident()) == {"t0", "t1", "t2"}
+        assert zoo.preload() == []  # idempotent
+    finally:
+        zoo.close()
+
+
+# ------------------------------------------------------------ cold load -----
+def test_zoo_load_fault_degrades_to_tenant_only_shed_and_replays():
+    """An injected ``zoo/load`` error fails ONE tenant's cold load as an
+    explicit shed (503 semantics: ServeOverloaded subclass with a
+    Retry-After) while other tenants keep serving; the call counter
+    advances, so the schedule replays deterministically."""
+    for _ in range(2):  # identical plan ⇒ identical outcome
+        zoo = _zoo()
+        zoo.add_tenant("cold", _model(1))
+        zoo.add_tenant("warm", _model(2))
+        try:
+            with faults.plan_scope(FaultPlan.parse("zoo/load:error@1")):
+                before = _counter("zoo/load_errors")
+                with pytest.raises(TenantLoadShed) as exc:
+                    zoo.runtime("cold")
+                assert exc.value.retry_after_s > 0
+                assert exc.value.tenant == "cold"
+                assert exc.value.reason == "cold_load"
+                assert _counter("zoo/load_errors") == before + 1
+                # Neighbor unaffected (its load is call 2 of the site).
+                entry, _ = zoo.runtime("warm")
+                assert entry.name == "warm"
+                # The failed tenant's retry reloads cleanly (call 3).
+                entry, rt = zoo.runtime("cold")
+                got = rt.batcher.submit(
+                    texts_to_bytes(TEXTS), want_labels=True
+                ).result().values
+                np.testing.assert_array_equal(got, _labels(_model(1)))
+        finally:
+            zoo.close()
+
+
+def test_disk_backed_tenant_pages_fully_and_reloads(tmp_path):
+    model = _model(5)
+    path = str(tmp_path / "m")
+    model.save(path)
+    zoo = _zoo(resident_models=1)
+    zoo.add_tenant("disk", path=path)
+    zoo.add_tenant("mem", _model(6))
+    try:
+        entry, rt = zoo.runtime("disk")
+        want = rt.batcher.submit(
+            texts_to_bytes(TEXTS), want_labels=True
+        ).result().values
+        zoo.runtime("mem")  # evicts "disk"
+        # Clean disk-backed tenant paged out model and all.
+        assert entry.runtime is None and entry.model is None
+        _, rt2 = zoo.runtime("disk")
+        got = rt2.batcher.submit(
+            texts_to_bytes(TEXTS), want_labels=True
+        ).result().values
+        np.testing.assert_array_equal(got, want)
+    finally:
+        zoo.close()
+
+
+# ------------------------------------------------------- quota lanes --------
+def test_noisy_tenant_sheds_itself_never_neighbors():
+    zoo = _zoo(
+        max_wait_ms=40, max_rows=8,
+        resident_models=None,
+    )
+    zoo.add_tenant(
+        "noisy", _model(1), quota=TenantQuota(max_queue_rows=8)
+    )
+    zoo.add_tenant("victim", _model(2))
+    try:
+        from spark_languagedetector_tpu.serve.batcher import (
+            BULK,
+            ServeOverloaded,
+        )
+
+        _, noisy = zoo.runtime("noisy")
+        _, victim = zoo.runtime("victim")
+        docs = texts_to_bytes(["abab"] * 4)
+        sheds = 0
+        futs = []
+        for _ in range(10):  # 40 rows into an 8-row quota lane
+            try:
+                futs.append(noisy.batcher.submit(docs, priority=BULK))
+            except ServeOverloaded as e:
+                assert e.reason == "queue_full"
+                sheds += 1
+        assert sheds >= 1
+        # The victim's lane is untouched: admits fine, zero sheds.
+        v = victim.batcher.submit(docs).result()
+        assert v.values.shape == (4, len(LANGS))
+        assert victim.batcher.stats()["shed_requests"] == 0
+        noisy_stats = noisy.batcher.stats()
+        assert noisy_stats["shed_requests"] == sheds
+        assert noisy_stats["shed_reasons"].get("queue_full") == sheds
+        assert _counter("zoo/shed/noisy") >= sheds
+        assert _counter("zoo/shed/victim") == 0
+        for f in futs:
+            f.result()
+    finally:
+        zoo.close()
+
+
+def test_admission_queue_local_shed_accounting():
+    """The exec-core half: every shed (except lifecycle "closed") lands
+    in the queue's own tallies, so a multi-queue front end can attribute
+    rejections without global counters."""
+    q = AdmissionQueue(max_rows=4, max_wait_s=0.01, max_queue_rows=4)
+    assert q.admit("a", 4, "interactive") == (None, 0.0)
+    reason, _ = q.admit("b", 4, "interactive")
+    assert reason == "queue_full"
+    stats = q.stats()
+    assert stats["shed_requests"] == 1
+    assert stats["shed_rows"] == 4
+    assert stats["shed_reasons"] == {"queue_full": 1}
+    q.close(drain=False)
+    assert q.admit("c", 1, "interactive")[0] == "closed"
+    assert q.stats()["shed_requests"] == 1  # closed is not a shed
+
+
+# -------------------------------------------------------- tenant refit ------
+def test_tenant_scoped_refit_swaps_exactly_one_tenant():
+    from spark_languagedetector_tpu import LanguageDetector, Table
+
+    zoo = _zoo()
+    zoo.add_tenant("learn", _model(1))
+    zoo.add_tenant("still", _model(2))
+    try:
+        zoo.preload()
+        est = LanguageDetector(list(LANGS), [1, 2], 80)
+        docs = ["aaa bab"] * 5 + ["xxy yxy"] * 5 + ["dcd cdd"] * 5
+        labs = ["l0"] * 5 + ["l1"] * 5 + ["l2"] * 5
+        ar = zoo.auto_refit(
+            "learn", est, refit_every_batches=1, final_refit=False
+        )
+        ar.run([Table({"lang": labs, "fulltext": docs})], max_batches=1)
+        assert zoo.version("learn") == "v2"
+        assert zoo.version("still") == "v1"
+        entry, rt = zoo.runtime("learn")
+        served = rt.registry.peek()
+        assert served.version == "v2"
+        meta = served.describe()["metadata"]
+        assert meta["tenant"] == "learn"
+        assert meta["refit_token"] == 1
+        got = rt.batcher.submit(
+            texts_to_bytes(TEXTS), want_labels=True
+        ).result()
+        np.testing.assert_array_equal(
+            got.values, _labels(ar.last_model)
+        )
+        # The neighbor still answers from its own v1.
+        _, rt2 = zoo.runtime("still")
+        got2 = rt2.batcher.submit(
+            texts_to_bytes(TEXTS), want_labels=True
+        ).result()
+        assert got2.version == "v1"
+        np.testing.assert_array_equal(got2.values, _labels(_model(2)))
+    finally:
+        zoo.close()
+
+
+def test_rollback_then_eviction_reloads_the_rolled_back_model():
+    """The paged state must resync on rollback: after install(v2) +
+    rollback(v1), an eviction + cold reload has to rebuild the v1 MODEL
+    under the v1 name — never the v2 model wearing v1's version."""
+    m1, m2 = _model(1), _model(7)
+    zoo = _zoo(resident_models=1)
+    zoo.add_tenant("a", m1)
+    zoo.add_tenant("b", _model(2))
+    try:
+        zoo.runtime("a")
+        assert zoo.install("a", m2) == "v2"
+        assert zoo.rollback("a") == "v1"
+        zoo.runtime("b")  # pages "a" out
+        assert zoo._entries["a"].runtime is None
+        _, rt = zoo.runtime("a")  # cold reload of the rolled-back state
+        got = rt.batcher.submit(
+            texts_to_bytes(TEXTS), want_labels=True
+        ).result()
+        assert got.version == "v1"
+        np.testing.assert_array_equal(got.values, _labels(m1))
+        # The next install still gets a fresh name (v2 is burnt).
+        assert zoo.install("a", _model(8)) == "v3"
+    finally:
+        zoo.close()
+
+
+def test_install_on_paged_out_tenant_lands_on_next_cold_load():
+    zoo = _zoo(resident_models=1)
+    zoo.add_tenant("a", _model(1))
+    zoo.add_tenant("b", _model(2))
+    try:
+        zoo.runtime("a")
+        zoo.runtime("b")  # pages "a" out
+        assert list(zoo.resident()) == ["b"]
+        new_model = _model(7)
+        version = zoo.install("a", new_model)
+        assert version == "v2" and zoo.version("a") == "v2"
+        entry, rt = zoo.runtime("a")  # cold load builds v2 directly
+        got = rt.batcher.submit(
+            texts_to_bytes(TEXTS), want_labels=True
+        ).result()
+        assert got.version == "v2"
+        np.testing.assert_array_equal(got.values, _labels(new_model))
+    finally:
+        zoo.close()
+
+
+# ---------------------------------------------------------- HTTP surface ----
+@pytest.fixture
+def zoo_http():
+    zoo = _zoo()
+    zoo.add_tenant("default", _model(0))
+    zoo.add_tenant("acme", _model(1))
+    server = ServingServer(zoo, port=0).start()
+    try:
+        yield zoo, server, ServeClient(*server.address)
+    finally:
+        server.stop()
+
+
+def test_http_tenant_routing_and_response_stamp(zoo_http):
+    zoo, server, client = zoo_http
+    labels, meta = client.detect(TEXTS, tenant="acme")
+    assert meta["tenant"] == "acme" and meta["version"] == "v1"
+    want = [LANGS[int(i)] for i in _labels(_model(1))]
+    assert labels == want
+    # A tenant-pinned client stamps every call.
+    pinned = ServeClient(*server.address, tenant="acme")
+    labels2, meta2 = pinned.detect(TEXTS)
+    assert labels2 == want and meta2["tenant"] == "acme"
+    scores, meta3 = pinned.score(TEXTS)
+    np.testing.assert_array_equal(
+        scores, _model(1)._get_runner().score(texts_to_bytes(TEXTS))
+    )
+    with pytest.raises(ServeHTTPError) as exc:
+        client.detect(TEXTS, tenant="nope")
+    assert exc.value.status == 400
+
+
+def test_http_no_tenant_resolves_default_bit_identically(zoo_http):
+    """Backward compat: the tenant-less wire against a zoo answers from
+    the default tenant with EXACTLY the single-model server's bytes."""
+    zoo, server, client = zoo_http
+    single = ServingServer(_model(0), port=0).start()
+    try:
+        sclient = ServeClient(*single.address)
+        scores_zoo, meta_zoo = client.score(TEXTS)
+        scores_single, _ = sclient.score(TEXTS)
+        np.testing.assert_array_equal(scores_zoo, scores_single)
+        assert meta_zoo["tenant"] == "default"
+        labels_zoo, _ = client.detect(TEXTS)
+        labels_single, _ = sclient.detect(TEXTS)
+        assert labels_zoo == labels_single
+    finally:
+        single.stop()
+
+
+def test_http_single_model_server_rejects_tenant_loudly():
+    server = ServingServer(_model(0), port=0).start()
+    try:
+        client = ServeClient(*server.address)
+        with pytest.raises(ServeHTTPError) as exc:
+            client.detect(TEXTS, tenant="acme")
+        assert exc.value.status == 400
+        # Admin surface too: a tenant-named swap/rollback against a
+        # single-model server must 400, never mutate the one model.
+        with pytest.raises(ServeHTTPError) as exc:
+            client.swap("/nowhere", tenant="acme")
+        assert exc.value.status == 400
+        with pytest.raises(ServeHTTPError) as exc:
+            client.rollback(tenant="acme")
+        assert exc.value.status == 400
+    finally:
+        server.stop()
+
+
+def test_http_healthz_varz_carry_per_tenant_blocks(zoo_http):
+    zoo, server, client = zoo_http
+    client.detect(TEXTS, tenant="acme")
+    h = client.healthz()
+    assert set(h["zoo"]["tenants"]) == {"default", "acme"}
+    acme = h["zoo"]["tenants"]["acme"]
+    assert acme["resident"] is True and acme["version"] == "v1"
+    assert "shed_requests" in acme["batcher"]
+    assert h["zoo"]["residency"]["resident_models"] >= 1
+    ready = client.readyz()
+    assert ready["ready"] is True and ready["tenants"] == 2
+    v = client.varz()
+    assert v["zoo"]["tenants"]["acme"]["versions"][0]["version"] == "v1"
+    assert "langdetect_zoo_resident_models" in v["gauges"]
+
+
+def test_http_admin_swap_and_rollback_tenant_scoped(zoo_http, tmp_path):
+    zoo, server, client = zoo_http
+    m = _model(7)
+    m.save(str(tmp_path / "m"))
+    # Make "acme" resident first: rollback walks the LIVE registry's
+    # history, which (documented) does not survive paging.
+    client.detect(TEXTS, tenant="acme")
+    version = client.swap(str(tmp_path / "m"), tenant="acme")
+    assert version == "v2"
+    assert zoo.version("acme") == "v2" and zoo.version("default") == "v1"
+    labels, meta = client.detect(TEXTS, tenant="acme")
+    assert meta["version"] == "v2"
+    assert labels == [LANGS[int(i)] for i in _labels(m)]
+    assert client.rollback(tenant="acme") == "v1"
+    assert zoo.version("acme") == "v1" and zoo.version("default") == "v1"
+
+
+def test_router_front_forwards_tenant_to_replica(zoo_http):
+    """The fleet tier carries the tenant name untouched: a RouterServer
+    over a zoo-backed replica answers per tenant like a direct client."""
+    from spark_languagedetector_tpu.serve.router import (
+        FleetRouter,
+        RouterServer,
+    )
+
+    zoo, server, client = zoo_http
+    router = FleetRouter([server.address], probe_interval_ms=50.0)
+    front = RouterServer(router, port=0)
+    router.start(probe=False)
+    front.start()
+    try:
+        rclient = ServeClient(*front.address, tenant="acme")
+        labels, meta = rclient.detect(TEXTS)
+        assert meta["tenant"] == "acme"
+        assert labels == [LANGS[int(i)] for i in _labels(_model(1))]
+        labels0, meta0 = ServeClient(*front.address).detect(TEXTS)
+        assert meta0["tenant"] == "default"
+        assert labels0 == [LANGS[int(i)] for i in _labels(_model(0))]
+        # The fleet admin surface is whole-fleet: a tenant-named
+        # swap/rollback is a loud 400, never a silent default-model
+        # mutation.
+        with pytest.raises(ServeHTTPError) as exc:
+            rclient.swap("/nowhere")  # tenant-pinned client stamps it
+        assert exc.value.status == 400
+        with pytest.raises(ServeHTTPError) as exc:
+            rclient.rollback()
+        assert exc.value.status == 400
+    finally:
+        front.stop()
+        router.close()
+
+
+def test_http_eviction_race_is_answered_not_dropped(zoo_http):
+    """A request racing its tenant's eviction re-resolves through the
+    cold-load path — answered, never dropped or cross-answered."""
+    zoo, server, client = zoo_http
+    stop = threading.Event()
+    errors = []
+
+    def churn():
+        # Evict whenever the tenant is idle — paced like a real admit-
+        # driven eviction, not a pathological lock-spin (a request that
+        # loses the race re-resolves through the cold-load path).
+        import time as _time
+
+        while not stop.is_set():
+            with zoo._lock:
+                if zoo._evictable_locked("acme"):
+                    zoo._evict_locked("acme")
+                    zoo._residency.drop("acme")
+            zoo._finish_evictions()
+            _time.sleep(0.001)
+
+    t = threading.Thread(target=churn, daemon=True)
+    t.start()
+    try:
+        want = [LANGS[int(i)] for i in _labels(_model(1))]
+        for _ in range(20):
+            labels, meta = client.detect(TEXTS, tenant="acme")
+            if labels != want:
+                errors.append(labels)
+        assert not errors
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+# ------------------------------------------------------- compare guard ------
+def _zoo_capture(path, counters):
+    events = [
+        {
+            "event": "telemetry.span", "ts": 1.0, "path": "serve/dispatch",
+            "wall_s": 0.01,
+        },
+        {
+            "event": "telemetry.snapshot", "ts": 2.0,
+            "counters": counters, "gauges": {}, "histograms": {},
+        },
+    ]
+    path.write_text("".join(json.dumps(ev) + "\n" for ev in events))
+
+
+def test_compare_cross_tenant_reject_appearance_regresses(tmp_path, capsys):
+    """Fixture-pinned direction (like the fleet counters): a
+    cross-tenant reject appearing against a zero baseline IS the
+    regression — no threshold can excuse it."""
+    from spark_languagedetector_tpu.telemetry.compare import main as c_main
+
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    _zoo_capture(a, {})
+    _zoo_capture(b, {"zoo/cross_tenant_rejects": 1})
+    assert c_main([str(a), str(b)]) == 1
+    assert "zoo/cross_tenant_rejects" in capsys.readouterr().out
+    capsys.readouterr()
+    assert c_main([str(b), str(b)]) == 0  # steady state passes
+
+
+def test_compare_per_tenant_shed_family_regresses(tmp_path, capsys):
+    from spark_languagedetector_tpu.telemetry.compare import main as c_main
+
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    _zoo_capture(a, {})
+    _zoo_capture(b, {"zoo/shed/noisy": 12})
+    assert c_main([str(a), str(b)]) == 1
+    assert "zoo/shed/noisy" in capsys.readouterr().out
+
+
+def test_compare_evictions_and_cold_loads_are_informational(tmp_path, capsys):
+    """Paging under a budget is normal life: evictions/cold loads are
+    shown in the diff but NEVER gate — in either direction, from any
+    baseline."""
+    from spark_languagedetector_tpu.telemetry.compare import main as c_main
+
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    _zoo_capture(a, {"zoo/evictions": 2, "zoo/cold_loads": 4})
+    _zoo_capture(b, {"zoo/evictions": 40, "zoo/cold_loads": 80})
+    assert c_main([str(a), str(b)]) == 0
+    out = capsys.readouterr().out
+    assert "zoo/evictions" in out and "informational" in out
+    capsys.readouterr()
+    empty = tmp_path / "empty.jsonl"
+    _zoo_capture(empty, {})
+    assert c_main([str(empty), str(a)]) == 0  # appearance: still fine
+    assert "informational" in capsys.readouterr().out
+
+
+# ------------------------------------------------------------- bench --------
+def test_bench_smoke_zoo_trimmed(tmp_path):
+    """The tier-1-sized --smoke-zoo: every hard gate (parity, zero
+    cross-tenant answers, evictions + cold reloads with leases
+    respected, noisy-neighbor isolation, tenant-scoped refit) holds at
+    the trimmed tenant count."""
+    import bench
+
+    result = bench.smoke_zoo(str(tmp_path / "zoo.jsonl"), trimmed=True)
+    assert result["ok"], result
+    assert result["argmax_parity"] == 1.0
+    assert result["cross_tenant_rejects"] == 0
+    assert result["evictions"] >= 1
+    assert result["cold_reloads"] >= 1
+    assert result["noisy"]["noisy_sheds"] >= 1
+    assert result["noisy"]["victim_sheds"] == 0
+    assert result["refit"]["swapped_tenant_versions"] == 1
+
+
+@pytest.mark.slow
+def test_bench_smoke_zoo_full(tmp_path):
+    """The full ~32-tenant CI gate (slow-marked: tier-1 runs trimmed)."""
+    import bench
+
+    result = bench.smoke_zoo(str(tmp_path / "zoo_full.jsonl"))
+    assert result["ok"], result
+    assert result["tenants"] >= 32

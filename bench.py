@@ -1867,6 +1867,272 @@ def smoke_fleet(jsonl_path: str | None = None, *, trimmed: bool = False) -> dict
     return result
 
 
+def smoke_scale(jsonl_path: str | None = None, *, trimmed: bool = False) -> dict:
+    """CPU-safe elastic-fleet smoke: subprocess replicas + autoscaler
+    under a scripted load ramp (docs/SERVING.md §13a).
+
+    Builds a min=1/max=3 :class:`ElasticFleet` of REAL subprocess
+    replicas (each loads the persisted model itself, owns its devices,
+    serves HTTP) behind the router front, then drives a quiet → burst →
+    quiet traffic ramp while the autoscaler ticks. Mid-burst the script
+    SIGKILLs one replica subprocess — the supervisor must restart it and
+    the router's half-open machinery re-admit it. Child replicas run
+    deliberately throttled admission knobs (small dispatch quantum, wide
+    flush window) so the burst genuinely saturates one replica's
+    measured service rate — the scale-up is driven by the same
+    estimated-wait signal production would see, not by a scripted
+    override.
+
+    Hard gates (``main()`` exits nonzero): replica count rises under the
+    burst AND falls back to the floor after it (>=1 scale-up and >=1
+    scale-down observed), at least one supervised subprocess restart,
+    zero dropped responses across the ramp, the kill, and every
+    membership change, and argmax parity exactly 1.0 against the direct
+    runner. ``trimmed=True`` is the tier-1-sized variant (max=2, shorter
+    phases, same gates).
+    """
+    import tempfile
+    import threading
+
+    from spark_languagedetector_tpu import LanguageDetector, Table
+    from spark_languagedetector_tpu.ops.encoding import texts_to_bytes
+    from spark_languagedetector_tpu.resilience.policy import RetryPolicy
+    from spark_languagedetector_tpu.scale import Autoscaler, ElasticFleet
+    from spark_languagedetector_tpu.serve.client import ServeClient, ServeHTTPError
+    from spark_languagedetector_tpu.serve.router import RouterServer
+    from spark_languagedetector_tpu.telemetry import REGISTRY
+    from spark_languagedetector_tpu.telemetry.export import JsonlSink
+
+    REGISTRY.reset()
+    path = jsonl_path or os.path.join(
+        tempfile.gettempdir(), f"scale_smoke_{os.getpid()}.jsonl"
+    )
+    sink = JsonlSink(path)
+    REGISTRY.add_sink(sink)
+
+    # Same corpus/model shape as --smoke-fleet: [1,2,3] gram lengths keep
+    # every replica on the geometry-stable gather strategy, so argmax
+    # parity vs the direct runner is strategy-sound.
+    langs = language_names(3)
+    docs, labels = make_corpus(langs, 60, mean_len=200, seed=3)
+    model = LanguageDetector(langs, [1, 2, 3], 200).fit(
+        Table({"lang": labels, "fulltext": docs})
+    )
+    runner = model._get_runner()
+    tmpdir = tempfile.mkdtemp(prefix="scale_smoke_")
+    model_dir = os.path.join(tmpdir, "model")
+    model.save(model_dir)
+
+    scale_max = 2 if trimmed else 3
+    burst_clients = 6 if trimmed else 8
+    docs_per_req = 24
+    # Throttled children: a 24-row dispatch quantum under a 25 ms flush
+    # window and a 48-row admission bound. The burst (clients x 24-row
+    # requests) overruns one replica's bound, so it sheds honestly —
+    # shed appearance is the autoscaler's pressure signal, the clients'
+    # Retry-After backoff absorbs the rejections (zero drops), and the
+    # pressure clears only when added replicas spread the load.
+    child_env = {
+        "LANGDETECT_SERVE_MAX_ROWS": "24",
+        "LANGDETECT_SERVE_MAX_WAIT_MS": "25",
+        "LANGDETECT_SERVE_QUEUE_ROWS": "48",
+    }
+    fleet = ElasticFleet(
+        model_dir, replicas=1,
+        fleet_name=f"smoke_scale_{os.getpid()}",
+        pidfile_dir=os.path.join(tmpdir, "pids"),
+        child_env=child_env,
+        # Warm founders, cold joiners: the floor replica is genuinely
+        # ready (compiled) before traffic starts, while scale-up
+        # replicas fold their compile into the first dispatch instead
+        # of the spawn latency the autoscaler waits out — a cold
+        # joiner's slow first batch is honest elastic-capacity behavior
+        # the clients' Retry-After backoff absorbs.
+        prewarm=True, joiner_prewarm=False,
+        router_kw=dict(
+            probe_interval_ms=40.0, breaker_threshold=2,
+            breaker_cooldown_s=0.3, probe_timeout_s=2.0,
+            drain_timeout_s=5.0,
+        ),
+    ).start()
+    scaler = Autoscaler(
+        fleet, scale_min=1, scale_max=scale_max, interval_ms=100.0,
+        up_ticks=2, down_ticks=4, pressure_wait_ms=30.0,
+        idle_rows_per_s=20.0,
+    ).start()
+    front = RouterServer(fleet.router, port=0).start()
+    host, port = front.address
+
+    lock = threading.Lock()
+    responses: list[tuple[list, list]] = []
+    errors: list[str] = []
+    live_samples: dict[str, list[int]] = {
+        "quiet1": [], "burst": [], "quiet2": [],
+    }
+    phase = ["quiet1"]
+    stop = threading.Event()
+
+    def drive(ci: int) -> None:
+        rng = np.random.default_rng(700 + ci)
+        client = ServeClient(
+            host, port, retry_policy=RetryPolicy(
+                # Wide budget: a cold joiner's first-dispatch compile can
+                # stall the whole fleet for a few seconds mid-burst; the
+                # clients must out-wait it, never drop.
+                max_attempts=30, base_delay_s=0.05, max_delay_s=0.5,
+                seed=700 + ci,
+            ),
+        )
+        while not stop.is_set():
+            current = phase[0]
+            if current == "quiet2" or (current == "quiet1" and ci > 0):
+                # Burst clients idle outside the burst; client 0 keeps a
+                # light pulse through quiet1 only — quiet2 is true
+                # silence so the arrival EMA decays to the floor.
+                time.sleep(0.05)
+                continue
+            n = docs_per_req if current == "burst" else 2
+            lo = int(rng.integers(0, len(docs) - n + 1))
+            texts = docs[lo:lo + n]
+            try:
+                got, _meta = client.detect(texts)
+            except (ServeHTTPError, OSError) as e:
+                with lock:
+                    errors.append(f"client {ci} [{current}]: {e}")
+                continue
+            with lock:
+                responses.append((texts, got))
+            if current == "quiet1":
+                time.sleep(0.04)
+
+    threads = [
+        threading.Thread(target=drive, args=(ci,))
+        for ci in range(burst_clients)
+    ]
+    for t in threads:
+        t.start()
+
+    def sample_phase(name: str, seconds: float) -> None:
+        deadline = time.monotonic() + seconds
+        while time.monotonic() < deadline:
+            live_samples[name].append(fleet.live_count())
+            time.sleep(0.1)
+
+    def counter(name: str) -> int:
+        return int(REGISTRY.snapshot()["counters"].get(name, 0))
+
+    def wait_for(pred, timeout_s: float) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(0.05)
+        return pred()
+
+    restart_drilled = [False]
+    try:
+        sample_phase("quiet1", 1.5 if trimmed else 3.0)
+        phase[0] = "burst"
+        # Burst until the autoscaler has demonstrably scaled up, then
+        # keep the pressure on while the kill drill runs.
+        wait_for(lambda: counter("scale/ups") >= 1, 60.0)
+        live_samples["burst"].append(fleet.live_count())
+        if counter("scale/ups") >= 1:
+            # SIGKILL the newest replica mid-burst: the supervisor must
+            # restart it on its pinned port and the router re-admit it.
+            # (_newest_member walks the member table under the
+            # supervisor's lock — the autoscaler thread may be admitting
+            # another member at this very moment.)
+            victim = fleet._newest_member()
+            rep = fleet.supervisor.members[victim]
+            before = counter("scale/restarts")
+            rep.proc.kill()
+            restart_drilled[0] = wait_for(
+                lambda: counter("scale/restarts") > before
+                and rep.alive, 90.0,
+            )
+            wait_for(
+                lambda: victim in fleet.router.eligible(), 15.0
+            )
+        sample_phase("burst", 1.0 if trimmed else 2.5)
+        phase[0] = "quiet2"
+        # True silence: the arrival EMA decays, the idle cooldown
+        # elapses, and the fleet walks back down to the floor.
+        wait_for(
+            lambda: counter("scale/downs") >= 1
+            and fleet.live_count() == 1,
+            90.0,
+        )
+        sample_phase("quiet2", 0.5)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        scaler.close()
+        final_health = fleet.healthz()
+        front.stop()
+        fleet.close()
+
+    # Parity: single model version throughout — every response must be
+    # label-exact against the direct runner, across replicas, the
+    # restart, and every membership change.
+    checked = mismatches = 0
+    for texts, got in responses:
+        ids = runner.predict_ids(texts_to_bytes(texts))
+        want = [langs[int(i)] for i in ids]
+        checked += 1
+        if got != want:
+            mismatches += 1
+    parity = 1.0 if checked and mismatches == 0 else (
+        round(1.0 - mismatches / checked, 6) if checked else 0.0
+    )
+
+    snap = REGISTRY.snapshot()
+    counters = snap["counters"]
+    peak_burst = max(live_samples["burst"] or [0])
+    end_quiet2 = (live_samples["quiet2"] or [0])[-1]
+    result = {
+        "smoke_scale": True,
+        "trimmed": trimmed,
+        "scale_min": 1,
+        "scale_max": scale_max,
+        "answered": len(responses),
+        "dropped_responses": len(errors),
+        "errors": errors[:5],
+        "argmax_parity": parity,
+        "scale_ups": int(counters.get("scale/ups", 0)),
+        "scale_downs": int(counters.get("scale/downs", 0)),
+        "supervised_restarts": int(counters.get("scale/restarts", 0)),
+        "spawn_failures": int(counters.get("scale/spawn_failures", 0)),
+        "failovers": int(counters.get("fleet/failovers", 0)),
+        "client_retries": int(counters.get("serve/client_retries", 0)),
+        "replica_timeline": {
+            "quiet1_max": max(live_samples["quiet1"] or [0]),
+            "burst_peak": peak_burst,
+            "quiet2_end": end_quiet2,
+        },
+        "restart_drilled": restart_drilled[0],
+        "health": {
+            "ready_replicas": final_health["ready_replicas"],
+            "target_replicas": final_health["target_replicas"],
+        },
+        "telemetry": telemetry_block(path),
+    }
+    result["ok"] = bool(
+        not errors
+        and parity == 1.0
+        and result["scale_ups"] >= 1
+        and result["scale_downs"] >= 1
+        and result["supervised_restarts"] >= 1
+        and restart_drilled[0]
+        and max(live_samples["quiet1"] or [0]) == 1
+        and peak_burst >= 2
+        and end_quiet2 == 1
+    )
+    REGISTRY.remove_sink(sink)
+    return result
+
+
 def smoke_refit(jsonl_path: str | None = None) -> dict:
     """CPU-safe continuous-learning smoke: the full data-in → model-out →
     serving loop under one gate (ROADMAP item 2).
@@ -3914,6 +4180,35 @@ def main():
                     "; ".join(result["errors"])
                     or "gate (drop/parity/failover/ejection/readmission/"
                     "swap-atomicity) not met"
+                ),
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        return
+    if "--smoke-scale" in sys.argv[1:]:
+        # Elastic-fleet smoke path: min=1/max=3 subprocess replicas +
+        # autoscaler under a quiet->burst->quiet load ramp with a
+        # mid-burst replica SIGKILL. Gates: replica count tracks the
+        # ramp up AND down, a supervised restart observed, zero dropped
+        # responses, argmax parity 1.0.
+        args = [a for a in sys.argv[1:] if a != "--smoke-scale"]
+        flags = [a for a in args if a.startswith("-")]
+        if flags or len(args) > 1:
+            print(
+                f"usage: python bench.py --smoke-scale [out.jsonl] "
+                f"(got {args})",
+                file=sys.stderr,
+            )
+            sys.exit(2)
+        result = smoke_scale(args[0] if args else None)
+        print(json.dumps(result), flush=True)
+        if not result["ok"]:
+            print(
+                "scale smoke FAILED: "
+                + (
+                    "; ".join(result["errors"])
+                    or "gate (ramp-up/ramp-down/restart/drop/parity) "
+                    "not met"
                 ),
                 file=sys.stderr,
             )

@@ -142,6 +142,33 @@ KNOBS: dict[str, Knob] = _knobs(
     Knob("fleet_drain_timeout_s", "LANGDETECT_FLEET_DRAIN_TIMEOUT_S",
          "float", 10.0, "per-replica drain bound during the two-phase "
          "fleet swap", positive=True),
+    # --- elastic scale (subprocess replicas + autoscaler: scale/) ---------
+    Knob("scale_min", "LANGDETECT_SCALE_MIN", "int", 1,
+         "autoscaler floor: minimum live replicas", positive=True),
+    Knob("scale_max", "LANGDETECT_SCALE_MAX", "int", 4,
+         "autoscaler ceiling: maximum live replicas", positive=True),
+    Knob("scale_interval_ms", "LANGDETECT_SCALE_INTERVAL_MS", "float",
+         500.0, "autoscaler control-loop tick period", positive=True),
+    Knob("scale_up_ticks", "LANGDETECT_SCALE_UP_TICKS", "int", 2,
+         "consecutive pressure ticks before a scale-up", positive=True),
+    Knob("scale_down_ticks", "LANGDETECT_SCALE_DOWN_TICKS", "int", 6,
+         "consecutive idle ticks (the cooldown) before a scale-down",
+         positive=True),
+    Knob("scale_pressure_wait_ms", "LANGDETECT_SCALE_PRESSURE_WAIT_MS",
+         "float", 50.0, "estimated fleet queue wait that counts as SLO "
+         "pressure", positive=True),
+    Knob("scale_idle_rows_per_s", "LANGDETECT_SCALE_IDLE_ROWS_PER_S",
+         "float", 1.0, "arrival-rate EMA below which an empty-queue tick "
+         "counts idle", positive=True),
+    Knob("scale_spawn_timeout_s", "LANGDETECT_SCALE_SPAWN_TIMEOUT_S",
+         "float", 120.0, "subprocess replica spawn-to-READY bound",
+         positive=True),
+    Knob("scale_max_restarts", "LANGDETECT_SCALE_MAX_RESTARTS", "int", 3,
+         "supervised restarts per replica incident before giving up",
+         positive=True),
+    Knob("scale_pidfile_dir", "LANGDETECT_SCALE_PIDFILE_DIR", "str", None,
+         "pidfile directory for orphan reaping (unset: per-fleet-name "
+         "tempdir)"),
     # --- resilience -------------------------------------------------------
     Knob("retry_max_attempts", "LANGDETECT_RETRY_MAX_ATTEMPTS", "int", 2,
          "retry attempts incl. the first try"),

@@ -72,6 +72,16 @@ Sites (one hook per serving layer; docs/RESILIENCE.md §4):
     call counter advances per attempt, so ``@1`` fails exactly the
     first cold load and its retry reloads cleanly, replaying
     deterministically like ``serve/cache``.
+  * ``scale/spawn``    — each subprocess-replica spawn attempt
+    (:meth:`scale.replica.ProcessReplica.spawn`): a firing ``error``
+    fails that attempt, exercising the supervisor's bounded
+    restart-with-backoff (and its give-up path past the budget)
+    deterministically on CPU (docs/SERVING.md §13).
+  * ``scale/decision`` — each autoscaler control-loop tick
+    (:meth:`scale.autoscaler.Autoscaler.tick`): a firing ``error``
+    skips that one tick entirely — fail-static, never a wrong scale
+    action — counted as ``scale/decision_skips``; ``%prob`` plans
+    replay the same skipped ticks for a given seed, like ``fleet/*``.
 """
 
 from __future__ import annotations
@@ -102,6 +112,8 @@ SITES = (
     "fleet/dispatch",
     "fleet/swap",
     "zoo/load",
+    "scale/spawn",
+    "scale/decision",
 )
 
 KINDS = ("error", "delay", "poison")

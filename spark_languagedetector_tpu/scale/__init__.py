@@ -1,0 +1,37 @@
+"""Elastic fleet: real subprocess replicas + telemetry-driven autoscaling.
+
+PR-9's :class:`~..serve.fleet.ServeReplica` is an in-process object
+sharing one loaded model — honest for protocol testing, useless for real
+capacity. This package goes real (docs/SERVING.md §13):
+
+  * :mod:`.replica` — :class:`ProcessReplica`, a serving replica that is
+    its own OS process (own model load, own devices via per-process
+    ``JAX_PLATFORMS``/``XLA_FLAGS``, own :class:`~..serve.server.
+    ServingServer`), and :class:`ReplicaSupervisor`, which spawns,
+    watches, restarts-with-backoff, and reaps them.
+  * :mod:`.autoscaler` — the control loop that closes the loop the
+    autotuner opened: arrival-rate EMA, queue depth, shed counters, and
+    estimated-wait SLO pressure in; replica count out, with hysteresis.
+  * :mod:`.elastic` — :class:`ElasticFleet`, wiring supervisor + the
+    dynamic-membership :class:`~..serve.router.FleetRouter` together so
+    routing, failover, ejection, and re-admission compose unchanged on a
+    changing replica set.
+
+The GSPMD/pjit portability result (PAPERS.md: arXiv:2105.04663,
+arXiv:2204.06514) is what makes this pure control plane: the per-replica
+compiled program is identical at every fleet size, so scale-out never
+touches the kernel path — only process lifecycle and router membership.
+"""
+
+from .autoscaler import Autoscaler, ScaleSignals
+from .elastic import ElasticFleet
+from .replica import ProcessReplica, ReplicaSupervisor, SpawnError
+
+__all__ = [
+    "Autoscaler",
+    "ElasticFleet",
+    "ProcessReplica",
+    "ReplicaSupervisor",
+    "ScaleSignals",
+    "SpawnError",
+]

@@ -1,0 +1,658 @@
+"""Subprocess serving replicas and the supervisor that keeps them alive.
+
+A :class:`ProcessReplica` is a real OS process (the ``--fit-scaling``
+subprocess harness and the jax.distributed ``--probe`` worker are the
+patterns): it loads its model from a persisted path, owns its devices via
+per-process ``JAX_PLATFORMS``/``XLA_FLAGS``, runs a
+:class:`~..serve.server.ServingServer` on its assigned port, and reports
+readiness over the existing ``/healthz/ready`` split — to the router it
+is indistinguishable from any other HTTP endpoint.
+
+Wire protocol between coordinator and child (docs/SERVING.md §13):
+
+  * The child prints exactly one ``READY {json}`` line on stdout once the
+    server is bound and the model is warm; everything else on the merged
+    stdout/stderr pipe is diagnostics, retained in a bounded tail for
+    spawn-failure messages.
+  * The child then blocks on stdin. EOF is the **pipe sentinel**: the
+    coordinator closing stdin (graceful stop) — or dying, even by
+    SIGKILL, which closes the pipe's write end — makes the child drain
+    its accepted work and exit. A replica can therefore never outlive its
+    coordinator silently; at worst it finishes in-flight requests and
+    leaves.
+  * SIGTERM to the child is the same graceful path (the orphan reaper
+    and container runtimes both speak it).
+
+The :class:`ReplicaSupervisor` owns the other half of the lifecycle:
+spawn with a readiness timeout, abrupt-death detection (``proc.poll()``
+plus the stdout-EOF sentinel), bounded restart-with-backoff through
+:class:`~..resilience.policy.RetryPolicy`, and **orphan reaping** — every
+spawn writes a pidfile, an ``atexit`` hook kills surviving children on
+coordinator exit, and a new supervisor on the same pidfile directory
+reaps children a SIGKILLed coordinator stranded (verifying
+``/proc/<pid>/cmdline`` is actually a replica worker before signalling,
+so a recycled pid is never shot).
+
+Chaos: the ``scale/spawn`` fault site fires inside each spawn attempt,
+so injected spawn errors exercise the restart-backoff path
+deterministically on CPU (docs/RESILIENCE.md §4).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+
+from ..exec import config as exec_config
+from ..resilience import faults
+from ..resilience.policy import RetryPolicy
+from ..telemetry import REGISTRY
+from ..utils.logging import get_logger, log_event
+
+_log = get_logger("scale.replica")
+
+READY_PREFIX = "READY "
+_WORKER_MODULE = "spark_languagedetector_tpu.scale.replica"
+
+
+class SpawnError(RuntimeError):
+    """A replica subprocess failed to reach readiness (spawn timeout,
+    early exit, or an injected ``scale/spawn`` fault). RuntimeError-shaped
+    so the retry classifier treats it as transient — which it is: the
+    supervisor's bounded backoff is the recovery path."""
+
+
+class ProcessReplica:
+    """One serving replica in its own OS process.
+
+    ``port=0`` lets the child bind an ephemeral port, reported back on
+    the READY line and **pinned** from then on: a supervisor restart puts
+    the replica back at the address the router knows, so the breaker's
+    half-open probe re-admits it without a membership change.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        model_path: str,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        platform: str = "cpu",
+        xla_flags: str | None = None,
+        env: dict | None = None,
+        prewarm: bool = True,
+        spawn_timeout_s: float | None = None,
+        tail_lines: int = 40,
+    ):
+        self.name = name
+        self.model_path = str(model_path)
+        self._host = host
+        self._port = int(port)
+        self._platform = platform
+        self._xla_flags = xla_flags
+        self._env = dict(env or {})
+        self._prewarm = prewarm
+        self.spawn_timeout_s = float(exec_config.resolve(
+            "scale_spawn_timeout_s", spawn_timeout_s
+        ))
+        self.proc: subprocess.Popen | None = None
+        self._eof = threading.Event()
+        self._ready_line: list[str] = []
+        self._ready_evt = threading.Event()
+        self._tail: deque[str] = deque(maxlen=tail_lines)
+        self._reader: threading.Thread | None = None
+
+    # ---------------------------------------------------------- properties --
+    @property
+    def address(self) -> tuple[str, int]:
+        return (self._host, self._port)
+
+    @property
+    def pid(self) -> int | None:
+        return self.proc.pid if self.proc is not None else None
+
+    @property
+    def alive(self) -> bool:
+        """The process exists and has not exited. Death shows up both
+        here (``poll()``) and on the stdout-EOF sentinel — the supervisor
+        checks either, so a child that dies between polls is still
+        caught the moment its pipe closes."""
+        return self.proc is not None and self.proc.poll() is None
+
+    def output_tail(self) -> list[str]:
+        """Last diagnostics lines from the child (spawn-failure detail)."""
+        return list(self._tail)
+
+    # ----------------------------------------------------------- lifecycle --
+    def _child_env(self) -> dict:
+        env = dict(os.environ)
+        # Per-process device ownership: the platform pin rides both the
+        # env var and a worker-side jax.config.update (the programmatic
+        # form is what wins under sitecustomize overrides).
+        env["JAX_PLATFORMS"] = self._platform
+        if self._xla_flags:
+            base = env.get("XLA_FLAGS", "")
+            env["XLA_FLAGS"] = f"{base} {self._xla_flags}".strip()
+        env.update(self._env)
+        return env
+
+    def spawn(self) -> "ProcessReplica":
+        """Start the child and block until its READY line (bounded).
+
+        Raises :class:`SpawnError` on timeout, early exit, or an injected
+        ``scale/spawn`` fault; the supervisor wraps this in the bounded
+        backoff schedule."""
+        if self.alive:
+            if not self._eof.is_set():
+                return self
+            # Alive but its pipe is gone: no longer supervisable — a
+            # respawn over it would leak the old process and fight it
+            # for the pinned port.
+            self.kill()
+        faults.inject("scale/spawn")
+        argv = [
+            sys.executable, "-m", _WORKER_MODULE, self.model_path,
+            "--name", self.name,
+            "--host", self._host,
+            "--port", str(self._port),
+            "--platform", self._platform,
+        ]
+        if not self._prewarm:
+            argv.append("--no-prewarm")
+        # Fresh per-spawn state, CAPTURED by this spawn's reader thread:
+        # a stale reader from the previous incarnation (never joined —
+        # it may be blocked on a half-dead pipe) still holds the OLD
+        # events/line list, so it can neither flag the new incarnation
+        # dead nor deliver the dead child's buffered READY line into the
+        # new spawn.
+        self._eof = eof = threading.Event()
+        self._ready_evt = ready_evt = threading.Event()
+        self._ready_line = ready_line = []
+        self.proc = subprocess.Popen(
+            argv,
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=self._child_env(),
+        )
+        self._reader = threading.Thread(
+            target=self._drain_stdout,
+            args=(self.proc, eof, ready_evt, ready_line),
+            name=f"scale-{self.name}-out", daemon=True,
+        )
+        self._reader.start()
+        deadline = time.monotonic() + self.spawn_timeout_s
+        while not ready_evt.wait(timeout=0.02):
+            if self.proc.poll() is not None:
+                raise SpawnError(
+                    f"replica {self.name!r} exited rc={self.proc.returncode} "
+                    f"before READY; tail={self.output_tail()[-3:]}"
+                )
+            if time.monotonic() >= deadline:
+                self.kill()
+                raise SpawnError(
+                    f"replica {self.name!r} spawn timed out after "
+                    f"{self.spawn_timeout_s}s; tail={self.output_tail()[-3:]}"
+                )
+        info = json.loads(ready_line[0][len(READY_PREFIX):])
+        self._port = int(info["port"])
+        log_event(
+            _log, "scale.replica.ready", replica=self.name, pid=self.pid,
+            port=self._port, version=info.get("version"),
+        )
+        return self
+
+    def _drain_stdout(self, proc, eof, ready_evt, ready_line) -> None:
+        # Keeps the pipe from filling (a blocked child is a fake hang)
+        # and doubles as the death sentinel: EOF fires the event even if
+        # nobody has called poll() yet. Operates ONLY on the captured
+        # per-spawn state — never self's — so a stale reader outliving
+        # its process cannot poison a later incarnation.
+        try:
+            for line in proc.stdout:
+                line = line.rstrip("\n")
+                if line.startswith(READY_PREFIX) and not ready_line:
+                    ready_line.append(line)
+                    ready_evt.set()
+                else:
+                    self._tail.append(line)
+        finally:
+            eof.set()
+
+    def kill(self) -> None:
+        """Abrupt death (the chaos drill / spawn-timeout escalation)."""
+        if self.proc is None:
+            return
+        try:
+            self.proc.kill()
+        except OSError:
+            pass
+        self.proc.wait(timeout=10.0)
+        self._close_pipes()
+        log_event(_log, "scale.replica.killed", replica=self.name,
+                  pid=self.proc.pid)
+
+    def stop(self, *, drain: bool = True, timeout_s: float = 30.0) -> None:
+        """Graceful stop: close stdin (the pipe sentinel) so the child
+        drains accepted work and exits; escalate to SIGTERM, then SIGKILL
+        if it overruns the bound. ``drain=False`` goes straight to
+        :meth:`kill`."""
+        if self.proc is None:
+            return
+        if not drain:
+            self.kill()
+            return
+        try:
+            if self.proc.stdin is not None:
+                self.proc.stdin.close()
+        except OSError:
+            pass
+        try:
+            self.proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=10.0)
+        self._close_pipes()
+        log_event(_log, "scale.replica.stop", replica=self.name,
+                  rc=self.proc.returncode)
+
+    def _close_pipes(self) -> None:
+        for pipe in (self.proc.stdin, self.proc.stdout):
+            try:
+                if pipe is not None:
+                    pipe.close()
+            except OSError:
+                pass
+
+
+# --------------------------------------------------------------- pidfiles ---
+def _pidfile(dirpath: str, name: str) -> str:
+    return os.path.join(dirpath, f"{name}.pid")
+
+
+def _pid_is_replica_worker(pid: int) -> bool:
+    """Is ``pid`` alive AND actually a replica worker? The /proc cmdline
+    check is what makes reaping safe against pid recycling — a stale
+    pidfile must never shoot an innocent process that inherited the pid."""
+    try:
+        os.kill(pid, 0)
+    except (OSError, ProcessLookupError):
+        return False
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            cmdline = f.read().decode("utf-8", "replace")
+    except OSError:
+        # Identity unprovable (no /proc, or the pid was recycled to a
+        # process we may not inspect): refuse to reap. Leaking an orphan
+        # a human can clean up beats shooting an innocent process that
+        # inherited the pid.
+        return False
+    return _WORKER_MODULE in cmdline
+
+
+class ReplicaSupervisor:
+    """Spawns, watches, restarts, and reaps :class:`ProcessReplica`s.
+
+    One supervisor per coordinator process. Construction reaps orphans
+    first: any pidfile in ``pidfile_dir`` whose pid is still a live
+    replica worker belongs to a coordinator that died without cleanup
+    (SIGKILL — atexit never ran), so it is terminated and counted
+    (``scale/orphans_reaped``) before this fleet binds ports. Two
+    concurrent coordinators must therefore use distinct pidfile dirs
+    (the default is keyed by fleet name under the system tempdir).
+    """
+
+    def __init__(
+        self,
+        model_path: str,
+        *,
+        host: str = "127.0.0.1",
+        platform: str = "cpu",
+        fleet_name: str = "fleet",
+        pidfile_dir: str | None = None,
+        spawn_timeout_s: float | None = None,
+        max_restarts: int | None = None,
+        prewarm: bool = True,
+        retry_policy: RetryPolicy | None = None,
+        child_env: dict | None = None,
+    ):
+        self.model_path = str(model_path)
+        self._host = host
+        self._platform = platform
+        self._child_env = dict(child_env or {})
+        self.fleet_name = fleet_name
+        dirpath = exec_config.resolve("scale_pidfile_dir", pidfile_dir)
+        if dirpath is None:
+            import tempfile
+
+            dirpath = os.path.join(
+                tempfile.gettempdir(), "langdetect_scale", fleet_name
+            )
+        self.pidfile_dir = str(dirpath)
+        os.makedirs(self.pidfile_dir, exist_ok=True)
+        self._spawn_timeout_s = spawn_timeout_s
+        self.max_restarts = int(exec_config.resolve(
+            "scale_max_restarts", max_restarts
+        ))
+        self._prewarm = prewarm
+        # Restart/spawn backoff, bounded by the restart budget. The
+        # default schedule deliberately starts at 250 ms (not the
+        # process-wide 50 ms retry default): a respawn on the pinned
+        # port races the kernel reclaiming the dead child's socket, and
+        # three sub-100 ms attempts can all land inside that window.
+        self.retry_policy = retry_policy or RetryPolicy.from_env(
+            max_attempts=max(1, self.max_restarts),
+            base_delay_s=0.25, max_delay_s=5.0,
+        )
+        self._lock = threading.Lock()
+        self.members: dict[str, ProcessReplica] = {}
+        # Members stopped on purpose (scale-down) — their death is not an
+        # incident; members whose restart budget ran out stay here too.
+        self._retired: set[str] = set()
+        self._failed: set[str] = set()
+        # Crash-loop guard: consecutive death→restart cycles per member
+        # (a member seen alive on a later poll resets its streak). The
+        # per-spawn backoff bounds one incident; the streak bounds a
+        # replica that keeps coming up and falling over.
+        self._restart_streak: dict[str, int] = {}
+        self.reap_orphans()
+        atexit.register(self._atexit_kill)
+
+    # ------------------------------------------------------------- orphans --
+    def reap_orphans(self) -> int:
+        """Kill replica workers a dead coordinator stranded; returns the
+        count. SIGTERM first (the worker's graceful-drain path), SIGKILL
+        only on overrun."""
+        reaped = 0
+        try:
+            entries = sorted(os.listdir(self.pidfile_dir))
+        except OSError:
+            return 0
+        for fname in entries:
+            if not fname.endswith(".pid"):
+                continue
+            path = os.path.join(self.pidfile_dir, fname)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    info = json.load(f)
+                pid = int(info["pid"])
+            except (OSError, ValueError, KeyError):
+                self._unlink(path)
+                continue
+            if _pid_is_replica_worker(pid):
+                try:
+                    os.kill(pid, signal.SIGTERM)
+                    for _ in range(100):
+                        if not _pid_is_replica_worker(pid):
+                            break
+                        time.sleep(0.05)
+                    else:
+                        os.kill(pid, signal.SIGKILL)
+                except OSError:
+                    pass
+                reaped += 1
+                REGISTRY.incr("scale/orphans_reaped")
+                log_event(
+                    _log, "scale.orphan_reaped", pid=pid,
+                    replica=info.get("name"), port=info.get("port"),
+                )
+            self._unlink(path)
+        return reaped
+
+    @staticmethod
+    def _unlink(path: str) -> None:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+    def _write_pidfile(self, rep: ProcessReplica) -> None:
+        path = _pidfile(self.pidfile_dir, rep.name)
+        tmp = f"{path}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({
+                "pid": rep.pid, "name": rep.name,
+                "host": rep.address[0], "port": rep.address[1],
+                "coordinator": os.getpid(),
+            }, f)
+        os.replace(tmp, path)
+
+    # ------------------------------------------------------------ lifecycle --
+    def spawn(
+        self, name: str, *, port: int = 0, prewarm: bool | None = None
+    ) -> ProcessReplica:
+        """Spawn one replica to readiness, under the bounded backoff
+        schedule. Every failed attempt counts ``scale/spawn_failures``;
+        exhaustion raises the last :class:`SpawnError`. ``prewarm``
+        overrides the supervisor default for THIS member (and sticks
+        across its restarts) — an elastic fleet warms its founders but
+        may admit joiners cold, folding their compile into the first
+        dispatch instead of the spawn latency."""
+        with self._lock:
+            existing = self.members.get(name)
+        if existing is not None and existing.alive:
+            raise ValueError(
+                f"replica {name!r} is already a live member; stop it "
+                "first or pick a fresh name"
+            )
+        rep = ProcessReplica(
+            name, self.model_path, host=self._host, port=port,
+            platform=self._platform,
+            prewarm=self._prewarm if prewarm is None else prewarm,
+            spawn_timeout_s=self._spawn_timeout_s, env=self._child_env,
+        )
+        self._spawn_with_backoff(rep)
+        with self._lock:
+            self.members[name] = rep
+            self._retired.discard(name)
+            self._failed.discard(name)
+        return rep
+
+    def _spawn_with_backoff(self, rep: ProcessReplica) -> None:
+        def attempt():
+            try:
+                return rep.spawn()
+            except Exception:
+                REGISTRY.incr("scale/spawn_failures")
+                raise
+
+        self.retry_policy.run(attempt, site="scale/spawn")
+        self._write_pidfile(rep)
+
+    def stop(self, name: str, *, drain: bool = True) -> None:
+        """Planned stop (scale-down): the member's later absence is not
+        an incident, so no restart fires."""
+        with self._lock:
+            rep = self.members.get(name)
+            self._retired.add(name)
+        if rep is not None:
+            rep.stop(drain=drain)
+            self._unlink(_pidfile(self.pidfile_dir, name))
+        with self._lock:
+            self.members.pop(name, None)
+
+    def poll_once(self) -> list[str]:
+        """One supervision round: detect abrupt deaths (poll + pipe
+        sentinel), restart each within its backoff budget, give up loudly
+        past it. Returns compact event strings (``"r1:restarted"``,
+        ``"r1:gave_up"``) — the deterministic lifecycle tests pin these.
+        """
+        events: list[str] = []
+        with self._lock:
+            snapshot = [
+                (name, rep) for name, rep in self.members.items()
+                if name not in self._retired and name not in self._failed
+            ]
+        for name, rep in snapshot:
+            if rep.alive and not rep._eof.is_set():
+                self._restart_streak[name] = 0
+                continue
+            streak = self._restart_streak.get(name, 0) + 1
+            self._restart_streak[name] = streak
+            log_event(
+                _log, "scale.replica.death_detected", replica=name,
+                rc=rep.proc.returncode if rep.proc else None, streak=streak,
+            )
+            if streak > self.max_restarts:
+                with self._lock:
+                    self._failed.add(name)
+                log_event(
+                    _log, "scale.replica.gave_up", replica=name,
+                    reason="crash_loop", budget=self.max_restarts,
+                )
+                events.append(f"{name}:gave_up")
+                continue
+            try:
+                self._spawn_with_backoff(rep)
+            except Exception as e:
+                with self._lock:
+                    self._failed.add(name)
+                log_event(
+                    _log, "scale.replica.gave_up", replica=name,
+                    error=repr(e), budget=self.max_restarts,
+                )
+                events.append(f"{name}:gave_up")
+                continue
+            # Counted on the restart actually HAPPENING — a death whose
+            # respawn gave up is visible as scale/spawn_failures + the
+            # gave-up event, not as a restart that never occurred.
+            REGISTRY.incr("scale/restarts")
+            events.append(f"{name}:restarted")
+        return events
+
+    def forget(self, name: str) -> None:
+        """Drop a member entirely — no restart candidacy, no pidfile, no
+        scale-down victim candidacy. The coordinator calls this after
+        detaching a gave-up member from routing; anything still running
+        is killed (it already failed its budget)."""
+        with self._lock:
+            rep = self.members.pop(name, None)
+            self._retired.discard(name)
+            self._failed.discard(name)
+            self._restart_streak.pop(name, None)
+        if rep is not None and rep.alive:
+            try:
+                rep.kill()
+            except Exception:
+                pass
+        self._unlink(_pidfile(self.pidfile_dir, name))
+
+    def live_count(self) -> int:
+        with self._lock:
+            return sum(
+                1 for name, rep in self.members.items()
+                if rep.alive and name not in self._retired
+            )
+
+    def close(self, *, drain: bool = True) -> None:
+        with self._lock:
+            names = list(self.members)
+        for name in names:
+            self.stop(name, drain=drain)
+        atexit.unregister(self._atexit_kill)
+
+    def abandon(self) -> None:
+        """Forget every child WITHOUT killing it — the coordinator-
+        SIGKILL simulation for the orphan-reap drill (tests only: a real
+        SIGKILL cannot run in-process). Pidfiles stay, atexit disarms;
+        the next supervisor on this pidfile dir must reap."""
+        with self._lock:
+            self.members.clear()
+            self._retired.clear()
+            self._failed.clear()
+        atexit.unregister(self._atexit_kill)
+
+    def _atexit_kill(self) -> None:
+        # Last-ditch: a coordinator exiting without close() must not
+        # strand children. Abrupt (kill, not drain) — atexit runs late,
+        # possibly with daemon threads already dead.
+        with self._lock:
+            reps = list(self.members.values())
+            self.members.clear()
+        for rep in reps:
+            try:
+                rep.kill()
+            except Exception:
+                pass
+            self._unlink(_pidfile(self.pidfile_dir, rep.name))
+
+    def __enter__(self) -> "ReplicaSupervisor":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# ------------------------------------------------------------ worker main ---
+def main(argv: list[str] | None = None) -> int:
+    """``python -m spark_languagedetector_tpu.scale.replica <model_dir>
+    --name r0 --host H --port P --platform cpu [--no-prewarm]`` — the
+    child half of :class:`ProcessReplica`. Not intended for direct use;
+    the READY-line/stdin-EOF protocol is the module docstring's contract.
+    """
+    import argparse
+
+    parser = argparse.ArgumentParser(prog=_WORKER_MODULE)
+    parser.add_argument("model_dir")
+    parser.add_argument("--name", default="replica")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--platform", default="cpu")
+    parser.add_argument("--no-prewarm", action="store_true")
+    args = parser.parse_args(argv)
+
+    # Pin this process's devices BEFORE any model load touches the
+    # backend. The programmatic update is what wins when a sitecustomize
+    # force-sets jax_platforms (same move as the jax.distributed probe
+    # worker).
+    import jax
+
+    jax.config.update("jax_platforms", args.platform)
+
+    from ..serve.registry import ModelRegistry
+    from ..serve.server import ServingServer
+
+    registry = ModelRegistry()
+    registry.load(args.model_dir, prewarm=not args.no_prewarm)
+    server = ServingServer(registry, host=args.host, port=args.port).start()
+    ready = {
+        "name": args.name,
+        "host": server.address[0],
+        "port": server.address[1],
+        "pid": os.getpid(),
+        "version": registry.current_version(),
+        "platform": args.platform,
+    }
+    print(READY_PREFIX + json.dumps(ready), flush=True)
+
+    def _sigterm(signum, frame):
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    try:
+        # The pipe sentinel: block until the coordinator closes stdin —
+        # on purpose (graceful stop) or by dying (any signal, including
+        # SIGKILL, closes the write end). Either way: drain and leave.
+        sys.stdin.buffer.read()
+    except (KeyboardInterrupt, SystemExit):
+        pass
+    finally:
+        server.stop(drain=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -156,6 +156,9 @@ class ServeFleet:
         # the prewarm scores would be pure repeats.
         seen: set[int] = set()
         self.replicas = []
+        self._host = host
+        self._batcher_kw = dict(batcher_kw)
+        self._name_seq = len(models)  # dynamic members continue r<i>
         for i, model in enumerate(models):
             first = id(model) not in seen
             seen.add(id(model))
@@ -165,10 +168,16 @@ class ServeFleet:
             ))
         self.router = FleetRouter(self.replicas, **(router_kw or {}))
         self.router.pin_version(version)
-        # Serializes swap/rollback: the two-phase protocol assumes one
-        # coordinator — two interleaved swaps could wedge the fleet with
-        # the pin naming a version no replica serves.
+        # Serializes swap/rollback AND membership changes: the two-phase
+        # protocol assumes one coordinator — two interleaved swaps could
+        # wedge the fleet with the pin naming a version no replica
+        # serves, and a replica must not join half-way through phase 2.
+        # ``_coordinator`` names the current holder so a swap arriving
+        # during routine membership churn WAITS for it (bounded by the
+        # drain timeout) instead of failing fast with a false "swap
+        # already in progress".
         self._swap_lock = threading.Lock()
+        self._coordinator: str | None = None
 
     @classmethod
     def from_path(
@@ -215,6 +224,98 @@ class ServeFleet:
                 return rep
         raise ValueError(f"unknown replica {name!r}")
 
+    # ------------------------------------------------- coordinator lock -----
+    def _acquire_coordinator(self, kind: str) -> None:
+        """One coordinator at a time. A swap/rollback arriving while
+        another swap/rollback runs fails fast (two interleaved protocol
+        rounds could wedge the pin); arriving while a bounded membership
+        change holds the lock, it WAITS — a scale-down drain is not "a
+        swap already in progress" and must not masquerade as one."""
+        while True:
+            if self._swap_lock.acquire(blocking=kind == "membership"):
+                self._coordinator = kind
+                return
+            holder = self._coordinator
+            if kind in ("swap", "rollback") and holder == "membership":
+                # Bounded wait: membership changes finish (drain bound),
+                # then the protocol round proceeds.
+                self._swap_lock.acquire()
+                self._coordinator = kind
+                return
+            raise FleetSwapError(
+                f"a fleet {holder or 'swap/rollback'} is already in "
+                "progress"
+            )
+
+    def _release_coordinator(self) -> None:
+        self._coordinator = None
+        self._swap_lock.release()
+
+    # -------------------------------------------------------- membership ----
+    def add_replica(
+        self, model=None, *, path: str | None = None,
+        name: str | None = None, prewarm: bool = True,
+    ) -> ServeReplica:
+        """Grow the fleet by one in-process replica mid-flight
+        (docs/SERVING.md §13). The new member installs the version the
+        router currently pins (or the fleet's current version), so it is
+        immediately swap-consistent; membership changes serialize with
+        swaps on the same coordinator lock — a replica can never join
+        half-way through phase 2."""
+        if (model is None) == (path is None):
+            raise ValueError("pass exactly one of model= or path=")
+        self._acquire_coordinator("membership")
+        try:
+            if path is not None:
+                from ..models.estimator import LanguageDetectorModel
+
+                model = LanguageDetectorModel.load(path)
+            version = self.router.pinned_version or (
+                self.replicas[0].registry.current_version()
+            )
+            if name is None:
+                name = f"r{self._name_seq}"
+                self._name_seq += 1
+            rep = ServeReplica(
+                name, model, host=self._host, version=version,
+                prewarm=prewarm, **self._batcher_kw,
+            )
+            self.replicas.append(rep)
+            self.router.add_replica(rep, name=name)
+            log_event(
+                _log, "fleet.replica.joined", replica=name, version=version,
+                replicas=len(self.replicas),
+            )
+            return rep
+        finally:
+            self._release_coordinator()
+
+    def remove_replica(self, name: str, *, drain: bool = True) -> None:
+        """Shrink the fleet by one: router drain-then-detach first (no
+        new traffic, outstanding requests waited out), then the replica's
+        own graceful stop drains its accepted batcher work — zero dropped
+        responses on the scale-down path. Removing the last replica is
+        refused (an empty fleet cannot answer anything)."""
+        self._acquire_coordinator("membership")
+        try:
+            rep = self.replica(name)
+            if len(self.replicas) == 1:
+                raise ValueError(
+                    "cannot remove the last replica of a serving fleet"
+                )
+            self.router.remove_replica(name, drain=drain)
+            self.replicas.remove(rep)
+            if drain:
+                rep.stop()
+            else:
+                rep.kill()
+            log_event(
+                _log, "fleet.replica.left", replica=name,
+                replicas=len(self.replicas),
+            )
+        finally:
+            self._release_coordinator()
+
     # ------------------------------------------------------------- swaps ----
     def _next_version(self) -> str:
         n = 0
@@ -250,16 +351,13 @@ class ServeFleet:
         two flips (a double-submitted ``/admin/swap`` must not wedge the
         pin on a version no replica serves).
         """
-        if not self._swap_lock.acquire(blocking=False):
-            raise FleetSwapError(
-                "a fleet swap/rollback is already in progress"
-            )
+        self._acquire_coordinator("swap")
         try:
             return self._swap_locked(
                 path, models=models, version=version, prewarm=prewarm
             )
         finally:
-            self._swap_lock.release()
+            self._release_coordinator()
 
     def _swap_locked(
         self,
@@ -376,14 +474,11 @@ class ServeFleet:
         a time behind the version pin) walked backwards — instant per
         replica, since the previous runners are still cached. Mutually
         exclusive with :meth:`swap` (same single-coordinator rule)."""
-        if not self._swap_lock.acquire(blocking=False):
-            raise FleetSwapError(
-                "a fleet swap/rollback is already in progress"
-            )
+        self._acquire_coordinator("rollback")
         try:
             return self._rollback_locked()
         finally:
-            self._swap_lock.release()
+            self._release_coordinator()
 
     def _rollback_locked(self) -> str:
         old = self.router.pinned_version or (

@@ -36,6 +36,14 @@ thread-safe object owns all of it:
     when every one of them shed does it raise :class:`FleetSaturated`
     (HTTP 503 + the smallest ``Retry-After`` any replica offered).
 
+  * **Dynamic membership.** :meth:`~FleetRouter.add_replica` admits a
+    new endpoint mid-flight with a fresh breaker;
+    :meth:`~FleetRouter.remove_replica` drains-then-detaches. Probing,
+    routing, failover, ejection, and the swap's version pin all read the
+    live handle table, so they compose unchanged on a changing replica
+    set — the control-plane half of the elastic fleet
+    (:mod:`..scale.elastic`, docs/SERVING.md §13).
+
 The version pin is the router's half of the two-phase fleet hot-swap
 (:mod:`.fleet`, docs/SERVING.md §9): while a swap is in flight, only
 replicas serving the pinned version are eligible, which is what keeps a
@@ -162,26 +170,21 @@ class FleetRouter:
         self.drain_timeout_s = float(exec_config.resolve(
             "fleet_drain_timeout_s", drain_timeout_s
         ))
-        threshold = int(exec_config.resolve(
+        # Kept for dynamic membership: add_replica builds late handles
+        # with the same breaker/timeout parameters the founders got.
+        self._breaker_threshold = int(exec_config.resolve(
             "fleet_breaker_threshold", breaker_threshold
         ))
-        cooldown = float(exec_config.resolve(
+        self._breaker_cooldown_s = float(exec_config.resolve(
             "fleet_breaker_cooldown_s", breaker_cooldown_s
         ))
+        self._request_timeout_s = float(request_timeout_s)
         self._lock = threading.Lock()
         self._pin: str | None = None
         self._handles: list[ReplicaHandle] = []
         for i, rep in enumerate(replicas):
             rname, host, port = _as_endpoint(i, rep)
-            self._handles.append(ReplicaHandle(
-                rname, host, port,
-                breaker=CircuitBreaker(
-                    failure_threshold=threshold, cooldown_s=cooldown,
-                    name=f"{name}:{rname}",
-                ),
-                request_timeout_s=request_timeout_s,
-                probe_timeout_s=self.probe_timeout_s,
-            ))
+            self._handles.append(self._new_handle(rname, host, port))
         if not self._handles:
             raise ValueError("a fleet router needs at least one replica")
         self._started = time.monotonic()
@@ -192,6 +195,78 @@ class FleetRouter:
             probe_interval_ms=self.probe_interval_s * 1e3,
             dispatch_attempts=self.dispatch_attempts,
         )
+
+    def _new_handle(self, rname: str, host: str, port: int) -> ReplicaHandle:
+        return ReplicaHandle(
+            rname, host, port,
+            breaker=CircuitBreaker(
+                failure_threshold=self._breaker_threshold,
+                cooldown_s=self._breaker_cooldown_s,
+                name=f"{self.name}:{rname}",
+            ),
+            request_timeout_s=self._request_timeout_s,
+            probe_timeout_s=self.probe_timeout_s,
+        )
+
+    # ------------------------------------------------------ membership ------
+    def add_replica(self, rep, *, name: str | None = None) -> str:
+        """Admit a replica into routing (docs/SERVING.md §13): a fresh
+        handle with a fresh CLOSED breaker — re-adding an address that
+        was removed earlier must never inherit the removed member's
+        ejection history. One immediate probe follows, so a healthy
+        replica is eligible without waiting for the next probe round.
+        Returns the member name; a duplicate name is a loud error."""
+        with self._lock:
+            idx = len(self._handles)
+        rname, host, port = _as_endpoint(idx, rep)
+        if name is not None:
+            rname = name
+        with self._lock:
+            if any(h.name == rname for h in self._handles):
+                raise ValueError(
+                    f"replica name {rname!r} already routed; remove it "
+                    "first or pick a fresh name"
+                )
+            h = self._new_handle(rname, host, port)
+            self._handles.append(h)
+        log_event(
+            _log, "fleet.replica.added", replica=rname,
+            address=f"{host}:{port}", replicas=idx + 1,
+        )
+        self._probe_replica(h)
+        return rname
+
+    def remove_replica(
+        self, name: str, *, drain: bool = True, timeout_s: float | None = None
+    ) -> bool:
+        """Detach a replica from routing: drain-then-detach. The member
+        is marked draining (no new picks), its outstanding routed
+        requests are waited out (bounded), and only then does the handle
+        leave the table — with its per-replica gauges zeroed so a
+        removed member never freezes a stale series. Returns whether the
+        drain completed inside the bound; on a timeout the handle still
+        detaches, and a straggler's release simply updates the detached
+        handle (the router's accounting can no longer be stranded by
+        it). Unknown names raise ``ValueError``."""
+        h = self._handle(name)
+        self.set_draining(name, True)
+        drained = True
+        if drain:
+            drained = self.wait_drained(name, timeout_s=timeout_s)
+        with self._lock:
+            if h in self._handles:
+                self._handles.remove(h)
+        REGISTRY.set_gauge(
+            "langdetect_fleet_replica_ready", 0.0, replica=name
+        )
+        REGISTRY.set_gauge(
+            "langdetect_fleet_outstanding_rows", 0.0, replica=name
+        )
+        log_event(
+            _log, "fleet.replica.removed", replica=name, drained=drained,
+            replicas=len(self._handles),
+        )
+        return drained
 
     # ---------------------------------------------------------- lifecycle ---
     def start(self, *, probe: bool = True) -> "FleetRouter":
@@ -235,9 +310,15 @@ class FleetRouter:
         ``"r1:readmitted"``, …) — the deterministic-replay tests pin
         sequences of these.
         """
+        # Snapshot under the lock: membership may change mid-round (a
+        # scale-down detaching a handle must not break the iteration); a
+        # just-removed member's last probe result lands on the detached
+        # handle, harmlessly.
+        with self._lock:
+            handles = list(self._handles)
         events: list[str] = []
-        with span("fleet/probe", replicas=len(self._handles)):
-            for h in self._handles:
+        with span("fleet/probe", replicas=len(handles)):
+            for h in handles:
                 evt = self._probe_replica(h)
                 if evt:
                     events.append(evt)
@@ -300,11 +381,18 @@ class FleetRouter:
         return f"{h.name}:ready" if ready else f"{h.name}:not_ready"
 
     def _replica_gauges(self, h: ReplicaHandle) -> None:
-        REGISTRY.set_gauge(
-            "langdetect_fleet_replica_ready",
-            1.0 if (h.ready and h.breaker.state == CLOSED) else 0.0,
-            replica=h.name,
-        )
+        # Membership check and gauge write under ONE lock hold: checking,
+        # releasing, then writing would let a concurrent remove_replica
+        # zero the series in the gap and have this stale write resurrect
+        # it forever. (Lock order router->registry matches _release.)
+        with self._lock:
+            if h not in self._handles:
+                return  # detached mid-flight: its series is already zeroed
+            REGISTRY.set_gauge(
+                "langdetect_fleet_replica_ready",
+                1.0 if (h.ready and h.breaker.state == CLOSED) else 0.0,
+                replica=h.name,
+            )
 
     # ------------------------------------------------------------ routing ---
     def _eligible_locked(self, h: ReplicaHandle) -> bool:
@@ -345,6 +433,11 @@ class FleetRouter:
     def _release(self, h: ReplicaHandle, rows: int) -> None:
         with self._lock:
             h.outstanding_rows = max(0, h.outstanding_rows - rows)
+            # A straggler finishing after remove_replica's drain timeout
+            # updates the detached handle but must not resurrect its
+            # zeroed gauge series.
+            if h not in self._handles:
+                return
             REGISTRY.set_gauge(
                 "langdetect_fleet_outstanding_rows",
                 float(h.outstanding_rows), replica=h.name,
@@ -535,9 +628,13 @@ class FleetRouter:
         return True
 
     def _handle(self, name: str) -> ReplicaHandle:
-        for h in self._handles:
-            if h.name == name:
-                return h
+        # Locked walk: the handle table mutates under dynamic membership,
+        # and an unlocked iteration racing a concurrent remove could skip
+        # the element shifted into the removed slot.
+        with self._lock:
+            for h in self._handles:
+                if h.name == name:
+                    return h
         raise ValueError(f"unknown replica {name!r}")
 
     # ------------------------------------------------------------- status ---

@@ -92,6 +92,12 @@ _RELIABILITY_COUNTERS = (
     # baseline is an isolation/availability regression, full stop.
     "zoo/cross_tenant_rejects",
     "zoo/load_errors",
+    # Elastic fleet (docs/SERVING.md §13): a replica spawn failing or a
+    # supervised restart firing against a clean baseline means replicas
+    # are dying or failing to come up — reliability regressions both.
+    # Scale-ups/downs are the autoscaler doing its job (informational).
+    "scale/spawn_failures",
+    "scale/restarts",
 )
 
 # Informational counters: diffed and shown like the reliability set but
@@ -102,6 +108,12 @@ _RELIABILITY_COUNTERS = (
 _INFORMATIONAL_COUNTERS = (
     "zoo/evictions",
     "zoo/cold_loads",
+    # Autoscaler actions and coordinator-crash cleanup: capacity
+    # following traffic (and a reaper doing its job on the NEXT start)
+    # is normal elastic life, not a regression — operator signal only.
+    "scale/ups",
+    "scale/downs",
+    "scale/orphans_reaped",
 )
 
 _TRACKED_RATIOS = {

@@ -640,6 +640,130 @@ def test_chaos_fleet_dispatch_replays_deterministically():
     assert a[3][1] == 2  # request 4 clean
 
 
+# ------------------------------------------------- dynamic membership -------
+def test_router_dynamic_membership_add_remove(fleet):
+    """Membership changes mid-flight: a drained-out member stops being
+    routed to, a newly added member becomes eligible after its admission
+    probe, and routing/least-outstanding composes unchanged on the new
+    set (ISSUE 15)."""
+    router = fleet.router
+    router.probe_once()
+    assert router.eligible() == ["r0", "r1", "r2"]
+    assert router.remove_replica("r2", drain=True)
+    assert router.eligible() == ["r0", "r1"]
+    labels, meta = router.detect(TEXTS)
+    assert meta["replica"] in ("r0", "r1")
+    with pytest.raises(ValueError):
+        router.remove_replica("r2")  # already detached: loud, not silent
+
+    # Grow back through the fleet (registry + batcher + server + router
+    # admission in one step): the joiner installs the pinned version.
+    rep = fleet.add_replica(model=_model(1))
+    assert rep.name == "r3"
+    assert rep.registry.current_version() == "v1"
+    assert sorted(router.eligible()) == ["r0", "r1", "r3"]
+    runner = fleet.replicas[0].registry.peek().runner
+    want = [LANGS[int(i)] for i in runner.predict_ids(texts_to_bytes(TEXTS))]
+    for _ in range(4):
+        labels, _meta = router.detect(TEXTS)
+        assert labels == want
+    # A duplicate name is refused loudly.
+    with pytest.raises(ValueError):
+        router.add_replica(rep, name="r3")
+
+
+def test_remove_replica_midflight_strands_nothing(fleet):
+    """The satellite hardening pin: removing a replica with requests
+    still outstanding (drain timeout expires) must not strand the
+    outstanding-rows accounting — the straggler's release lands on the
+    detached handle, the zeroed gauge series stays zeroed, and a later
+    re-add of the same (host, port) starts from clean accounting."""
+    router = fleet.router
+    router.probe_once()
+    h = router._pick(5, {"r1", "r2"})
+    assert h.name == "r0" and router.outstanding("r0") == 5
+    # Drain cannot complete (5 rows outstanding): bounded, then detach.
+    assert router.remove_replica("r0", drain=True, timeout_s=0.05) is False
+    assert "r0" not in router.eligible()
+    gauges = REGISTRY.snapshot()["gauges"]
+    assert gauges["langdetect_fleet_outstanding_rows"]["replica=r0"] == 0.0
+    # The straggler finishes: release updates the detached handle only —
+    # no error, and the zeroed series is not resurrected.
+    router._release(h, 5)
+    assert h.outstanding_rows == 0
+    gauges = REGISTRY.snapshot()["gauges"]
+    assert gauges["langdetect_fleet_outstanding_rows"]["replica=r0"] == 0.0
+
+    # Same (host, port) re-admitted: fresh handle, clean accounting.
+    rep = fleet.replica("r0")  # still alive: only routing was detached
+    router.add_replica(rep, name="r0")
+    assert router.outstanding("r0") == 0
+    assert "r0" in router.eligible()
+
+
+def test_readd_same_address_gets_fresh_breaker(fleet):
+    """The other half of the satellite pin: a member ejected (breaker
+    open) and then REMOVED must not leave a breaker entry that blocks a
+    later re-add on the same (host, port) — the joiner gets a fresh
+    CLOSED breaker and is eligible on its admission probe, no cooldown
+    owed."""
+    router = fleet.router
+    fleet.replica("r0").kill()
+    router.probe_once()
+    router.probe_once()  # threshold=2: ejected, breaker open
+    assert router._handle("r0").breaker.state == "open"
+    assert "r0" not in router.eligible()
+    router.remove_replica("r0", drain=False)
+
+    fleet.replica("r0").revive()  # same pinned port
+    router.add_replica(fleet.replica("r0"), name="r0")
+    # No sleep anywhere: were the open breaker inherited, eligibility
+    # would owe the 0.15s cooldown + a half-open probe round.
+    assert router._handle("r0").breaker.state == "closed"
+    assert "r0" in router.eligible()
+    labels, meta = router.detect(TEXTS, priority="interactive")
+    assert meta["replica"] in router.eligible()
+
+
+def test_fleet_membership_composes_with_swap(fleet):
+    """The two-phase swap and rollback operate on whatever the
+    membership is NOW: a post-construction joiner flips with the fleet,
+    and a member that left is simply not part of the next protocol
+    round."""
+    fleet.add_replica(model=_model(1))  # -> r3
+    assert len(fleet.replicas) == 4
+    version = fleet.swap(models=_models(2, 4))
+    assert version == "v2"
+    assert set(fleet.versions().values()) == {"v2"}
+    assert fleet.versions()["r3"] == "v2"
+
+    # A joiner admitted AFTER the swap installs the pinned new version.
+    rep = fleet.add_replica(model=_model(2))
+    assert rep.registry.current_version() == "v2"
+
+    fleet.remove_replica(rep.name)
+    assert rep.name not in fleet.router.eligible()
+    fleet.remove_replica("r3", drain=False)
+    assert len(fleet.replicas) == 3
+    target = fleet.rollback()
+    assert target == "v1"
+    assert set(fleet.versions().values()) == {"v1"}
+
+
+def test_remove_last_replica_refused():
+    fl = _fleet(seed=3)
+    fl.start(probe=False)
+    try:
+        fl.router.probe_once()
+        for name in ("r1", "r2"):
+            fl.remove_replica(name, drain=False)
+        with pytest.raises(ValueError):
+            fl.remove_replica("r0")
+        assert fl.router.eligible() == ["r0"]
+    finally:
+        fl.close()
+
+
 # ------------------------------------------------------- bench smoke gate ---
 def test_bench_smoke_fleet_trimmed(tmp_path):
     """Tier-1-sized fleet smoke: the full kill/eject/readmit/swap drill

@@ -1,0 +1,438 @@
+"""Elastic fleet: subprocess replicas, supervisor, autoscaler (ISSUE 15).
+
+The acceptance contract: a :class:`ProcessReplica` spawned from a
+persisted model serves label-identical answers to the direct runner; an
+abrupt child death is detected and restarted on the pinned port within
+the bounded backoff budget (and the router's breaker machinery re-admits
+it without a membership change); a SIGKILLed coordinator's stranded
+children are reaped by the next supervisor on the same pidfile dir; the
+autoscaler's hysteresis never flaps, defers mid-outage, and its
+``scale/decision`` fault site skips ticks — never a wrong scale action —
+with ``%prob`` plans replaying deterministically like ``fleet/*``.
+"""
+
+import functools
+import os
+import time
+
+import numpy as np
+import pytest
+
+from spark_languagedetector_tpu import LanguageDetectorModel
+from spark_languagedetector_tpu.exec.core import AdmissionQueue
+from spark_languagedetector_tpu.ops.encoding import texts_to_bytes
+from spark_languagedetector_tpu.resilience import faults
+from spark_languagedetector_tpu.resilience.faults import FaultPlan
+from spark_languagedetector_tpu.resilience.policy import RetryPolicy
+from spark_languagedetector_tpu.scale import (
+    Autoscaler,
+    ProcessReplica,
+    ReplicaSupervisor,
+    ScaleSignals,
+    SpawnError,
+)
+from spark_languagedetector_tpu.serve.client import ServeClient
+from spark_languagedetector_tpu.serve.router import FleetRouter
+from spark_languagedetector_tpu.telemetry import REGISTRY
+
+LANGS = ("x", "y")
+GRAM_KEYS = (b"ab", b"bc", b"zz", b"abc")
+TEXTS = ["abab", "zz", "abczz", "bcbc"]
+
+# Fast, deterministic backoff for every supervisor in this module: the
+# schedules are exercised, the sleeps are not the point.
+FAST_RETRY = RetryPolicy(
+    max_attempts=3, base_delay_s=0.01, max_delay_s=0.02, seed=7
+)
+
+# Cold spawns (no prewarm) keep each subprocess bring-up a few seconds:
+# the lifecycle under test is the process protocol, not the compile.
+SUP_KW = dict(retry_policy=FAST_RETRY, prewarm=False)
+
+
+@functools.lru_cache(maxsize=None)
+def _model(seed=0):
+    rng = np.random.default_rng(seed)
+    gram_map = {g: rng.normal(size=2).tolist() for g in GRAM_KEYS}
+    return LanguageDetectorModel.from_gram_map(gram_map, (2, 3), LANGS)
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    path = tmp_path_factory.mktemp("scale_model") / "m"
+    _model(0).save(str(path))
+    return str(path)
+
+
+def _counter(name):
+    return int(REGISTRY.snapshot()["counters"].get(name, 0))
+
+
+def _wait(pred, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return pred()
+
+
+# ------------------------------------------------- subprocess lifecycle -----
+def test_subprocess_replica_full_lifecycle(model_dir, tmp_path):
+    """The whole subprocess story in one fleet: spawn (through an
+    injected first-attempt failure, exercising the backoff) → READY →
+    label parity vs the direct runner → SIGKILL → supervisor restart on
+    the pinned port → router ejection + half-open re-admission without a
+    membership change → graceful close with no pidfile left."""
+    runner = _model(0)._get_runner()
+    want = [LANGS[int(i)] for i in runner.predict_ids(texts_to_bytes(TEXTS))]
+
+    sup = ReplicaSupervisor(
+        model_dir, pidfile_dir=str(tmp_path / "pids"),
+        fleet_name=f"t_lifecycle_{os.getpid()}", **SUP_KW,
+    )
+    try:
+        fails0 = _counter("scale/spawn_failures")
+        with faults.plan_scope(FaultPlan.parse("scale/spawn:error@1")):
+            rep = sup.spawn("r0")
+        # Attempt 1 injected-failed (counted), attempt 2 spawned: the
+        # restart-backoff path ran without costing a real process.
+        assert _counter("scale/spawn_failures") - fails0 == 1
+        assert rep.alive and rep.address[1] > 0
+        assert os.path.exists(str(tmp_path / "pids" / "r0.pid"))
+
+        client = ServeClient(*rep.address)
+        assert client.readyz()["ready"]
+        got, meta = client.detect(TEXTS)
+        assert got == want and meta["version"] == "v1"
+
+        # Router over the subprocess replica: probes driven explicitly.
+        router = FleetRouter(
+            [rep], probe_interval_ms=30.0, breaker_threshold=2,
+            breaker_cooldown_s=0.2, probe_timeout_s=2.0,
+        )
+        router.probe_once()
+        assert router.eligible() == ["r0"]
+
+        # Abrupt death: poll + pipe sentinel both observe it.
+        port = rep.address[1]
+        pid = rep.pid
+        rep.proc.kill()
+        assert _wait(lambda: not rep.alive, 15.0)
+        assert _wait(rep._eof.is_set, 15.0)
+
+        # The prober watches the address fail and ejects (two failed
+        # probes at threshold 2) — membership unchanged.
+        router.probe_once()
+        router.probe_once()
+        assert router.eligible() == []
+
+        restarts0 = _counter("scale/restarts")
+        assert sup.poll_once() == ["r0:restarted"]
+        assert _counter("scale/restarts") - restarts0 == 1
+        assert rep.alive and rep.pid != pid
+        assert rep.address[1] == port  # pinned: the address the router knows
+
+        # Cooldown elapses → the half-open probe re-admits the replica.
+        time.sleep(0.25)
+        assert _wait(lambda: "r0:readmitted" in router.probe_once(), 10.0)
+        assert router.eligible() == ["r0"]
+        got, _ = client.detect(TEXTS)
+        assert got == want
+
+        # A healthy member resets its crash-loop streak.
+        assert sup.poll_once() == []
+        assert sup._restart_streak["r0"] == 0
+    finally:
+        sup.close()
+    assert not rep.alive
+    assert os.listdir(str(tmp_path / "pids")) == []
+
+
+def test_spawn_exhaustion_is_bounded_and_loud(model_dir, tmp_path):
+    """Every spawn attempt injected to fail: the bounded backoff burns
+    its budget, counts each failure, and raises — no process ever
+    started, no member registered."""
+    sup = ReplicaSupervisor(
+        model_dir, pidfile_dir=str(tmp_path / "pids"),
+        fleet_name=f"t_exhaust_{os.getpid()}", **SUP_KW,
+    )
+    try:
+        fails0 = _counter("scale/spawn_failures")
+        with faults.plan_scope(FaultPlan.parse("scale/spawn:error@1-9")):
+            with pytest.raises(faults.InjectedFault):
+                sup.spawn("r0")
+        assert _counter("scale/spawn_failures") - fails0 == 3
+        assert sup.members == {}
+    finally:
+        sup.close()
+
+
+def test_coordinator_sigkill_orphan_reap(model_dir, tmp_path):
+    """A coordinator that dies without cleanup (abandon() — the in-
+    process stand-in for SIGKILL, which can never run atexit) strands a
+    live child; the NEXT supervisor on the same pidfile dir reaps it
+    before binding anything, and counts it."""
+    pids = str(tmp_path / "pids")
+    sup = ReplicaSupervisor(
+        model_dir, pidfile_dir=pids,
+        fleet_name=f"t_orphan_{os.getpid()}", **SUP_KW,
+    )
+    rep = sup.spawn("r0")
+    assert rep.alive
+    sup.abandon()  # children deliberately NOT killed; pidfiles stay
+    assert rep.alive and os.listdir(pids) == ["r0.pid"]
+
+    reaped0 = _counter("scale/orphans_reaped")
+    sup2 = ReplicaSupervisor(
+        model_dir, pidfile_dir=pids,
+        fleet_name=f"t_orphan_{os.getpid()}", **SUP_KW,
+    )
+    try:
+        assert _counter("scale/orphans_reaped") - reaped0 == 1
+        assert _wait(lambda: not rep.alive, 15.0)
+        assert os.listdir(pids) == []
+    finally:
+        sup2.close()
+
+
+def test_orphan_reap_ignores_stale_and_foreign_pidfiles(tmp_path):
+    """A pidfile whose pid is dead — or alive but NOT a replica worker
+    (pid recycling) — is cleaned up without signalling anything."""
+    pids = tmp_path / "pids"
+    pids.mkdir()
+    (pids / "dead.pid").write_text('{"pid": 999999999, "name": "dead"}')
+    # This very test process is alive but is not a replica worker.
+    (pids / "self.pid").write_text(
+        '{"pid": %d, "name": "self"}' % os.getpid()
+    )
+    (pids / "garbage.pid").write_text("not json")
+    reaped0 = _counter("scale/orphans_reaped")
+    sup = ReplicaSupervisor(
+        "/nonexistent/model", pidfile_dir=str(pids),
+        fleet_name=f"t_stale_{os.getpid()}", **SUP_KW,
+    )
+    try:
+        assert _counter("scale/orphans_reaped") - reaped0 == 0
+        assert sorted(os.listdir(str(pids))) == []
+    finally:
+        sup.close()
+
+
+# ------------------------------------------------------- admission odometer --
+def test_admission_queue_admitted_rows_odometer():
+    """``admitted_rows`` is the monotone arrival odometer the autoscaler
+    differentiates — it grows on every admission and never resets on
+    dispatch (unlike ``queued_rows``) or on silence (unlike a rate)."""
+    q = AdmissionQueue(max_rows=8, max_wait_s=0.0, max_queue_rows=100)
+    assert q.stats()["admitted_rows"] == 0
+    q.admit("a", 3, "interactive")
+    q.admit("b", 2, "interactive")
+    assert q.stats()["admitted_rows"] == 5
+    q.next_batch()
+    q.done()
+    stats = q.stats()
+    assert stats["queued_rows"] == 0 and stats["admitted_rows"] == 5
+    q.admit("c", 4, "interactive")
+    assert q.stats()["admitted_rows"] == 9
+    # Sheds do NOT advance the odometer: rejected rows never arrived as
+    # far as the service loop is concerned.
+    reason, _ = q.admit("d", 1000, "interactive")
+    assert reason == "queue_full"
+    assert q.stats()["admitted_rows"] == 9
+    q.close(drain=False)
+
+
+# ------------------------------------------------------------- autoscaler ----
+class FakeFleet:
+    """Deterministic fleet stand-in: the autoscaler's whole contract is
+    ``check_members()`` / ``signals()`` / ``scale_to(n)`` / ``target``."""
+
+    def __init__(self, live=1):
+        self.target = live
+        self.live = live
+        self.sig = ScaleSignals(live=live, ready=live)
+        self.scale_calls: list[int] = []
+
+    def check_members(self):
+        return []
+
+    def signals(self):
+        self.sig.live = self.live
+        return self.sig
+
+    def scale_to(self, n):
+        self.scale_calls.append(n)
+        self.target = n
+        self.live = n
+        return n
+
+
+def _scaler(fleet, **kw):
+    kw.setdefault("scale_min", 1)
+    kw.setdefault("scale_max", 3)
+    kw.setdefault("up_ticks", 2)
+    kw.setdefault("down_ticks", 3)
+    kw.setdefault("pressure_wait_ms", 50.0)
+    kw.setdefault("idle_rows_per_s", 1.0)
+    kw.setdefault("interval_ms", 10_000.0)  # ticks driven by hand
+    return Autoscaler(fleet, **kw)
+
+
+def test_autoscaler_hysteresis_up_and_down():
+    """Pressure must persist ``up_ticks`` before a spawn; idleness must
+    persist ``down_ticks`` (the cooldown) before a drain — one spike in
+    either direction never moves the fleet."""
+    fl = FakeFleet(live=1)
+    sc = _scaler(fl)
+    # One pressure tick: streak 1 of 2 — hold.
+    fl.sig.est_wait_ms = 100.0
+    assert sc.tick() == "hold"
+    # Pressure broke: streak resets; two clean ticks, then one pressure
+    # tick — still hold (the spike never accumulates across gaps).
+    fl.sig.est_wait_ms = 0.0
+    assert sc.tick() == "hold"
+    fl.sig.est_wait_ms = 100.0
+    assert sc.tick() == "hold"
+    # Sustained pressure: second consecutive tick scales up.
+    assert sc.tick() == "up"
+    assert fl.scale_calls == [2]
+    # Shed appearance alone is also pressure.
+    fl.sig.est_wait_ms = 0.0
+    fl.sig.shed_delta = 4
+    assert sc.tick() == "hold"
+    assert sc.tick() == "up"
+    assert fl.target == 3
+    # Idle now: queue empty, nothing in flight, EMA under the floor —
+    # but only after down_ticks consecutive ticks.
+    fl.sig.shed_delta = 0
+    fl.sig.ema_rows_per_s = 0.1
+    assert sc.tick() == "hold"
+    assert sc.tick() == "hold"
+    assert sc.tick() == "down"
+    assert fl.target == 2
+    # A traffic blip resets the idle cooldown.
+    fl.sig.ema_rows_per_s = 50.0
+    assert sc.tick() == "hold"
+    fl.sig.ema_rows_per_s = 0.1
+    assert sc.tick() == "hold"
+    assert sc.tick() == "hold"
+    assert sc.tick() == "down"
+    assert fl.target == 1
+
+
+def test_autoscaler_clamps_min_max():
+    fl = FakeFleet(live=3)
+    sc = _scaler(fl, scale_min=2, scale_max=3)
+    fl.sig.est_wait_ms = 1000.0
+    for _ in range(10):  # sustained pressure at the ceiling: never past it
+        sc.tick()
+    assert fl.target == 3 and fl.scale_calls == []
+    fl.sig.est_wait_ms = 0.0
+    fl.sig.ema_rows_per_s = 0.0
+    for _ in range(20):  # sustained idleness: stops at the floor
+        sc.tick()
+    assert fl.target == 2 and fl.scale_calls == [2]
+
+
+def test_autoscaler_defers_mid_outage():
+    """A breaker-open member or a fleet below target (supervised restart
+    in flight) freezes scale decisions: the breaker/half-open machinery
+    owns the fleet's shape mid-outage — a dead replica must never read
+    as idleness."""
+    fl = FakeFleet(live=2)
+    sc = _scaler(fl, scale_min=1)
+    fl.sig.ema_rows_per_s = 0.0
+    fl.sig.breaker_open = True
+    for _ in range(10):
+        assert sc.tick() == "deferred"
+    assert fl.scale_calls == []
+    # Restart in flight: live below target defers the same way.
+    fl.sig.breaker_open = False
+    fl.live = 1
+    fl.sig.live = 1
+    assert sc.tick() == "deferred"
+    # Recovered: the idle cooldown starts counting only now.
+    fl.live = 2
+    assert sc.tick() == "hold"
+    assert sc.tick() == "hold"
+    assert sc.tick() == "down"
+
+
+def test_autoscaler_min_floor_repair():
+    """A member past its restart budget drops the target below the
+    floor; the next tick spawns a fresh replacement rather than serving
+    under min."""
+    fl = FakeFleet(live=2)
+    sc = _scaler(fl, scale_min=2, scale_max=3)
+    fl.target = 1  # what ElasticFleet.check_members does on gave_up
+    fl.live = 1
+    assert sc.tick() == "up"
+    assert fl.scale_calls == [2] and fl.target == 2
+
+
+def test_scale_decision_fault_skips_tick_never_wrong_action():
+    """An injected ``scale/decision`` error skips exactly that tick —
+    fail-static: even under sustained pressure the faulted tick takes no
+    scale action, and the streak does not advance behind its back."""
+    fl = FakeFleet(live=1)
+    sc = _scaler(fl, up_ticks=2)
+    fl.sig.est_wait_ms = 1000.0
+    skips0 = _counter("scale/decision_skips")
+    with faults.plan_scope(FaultPlan.parse("scale/decision:error@2")):
+        assert sc.tick() == "hold"     # pressure streak 1
+        assert sc.tick() == "skipped"  # injected: tick 2 does not happen
+        assert fl.scale_calls == []
+        assert sc.tick() == "up"       # streak completes on the next tick
+    assert _counter("scale/decision_skips") - skips0 == 1
+    assert fl.target == 2
+
+
+def test_scale_decision_prob_plan_replays_deterministically():
+    """%prob plans at ``scale/decision`` fire on the same tick numbers
+    for the same seed — two installs, identical skip schedules (the same
+    pinned-replay contract as the ``fleet/*`` sites)."""
+
+    def run_schedule():
+        fl = FakeFleet(live=1)
+        sc = _scaler(fl)
+        out = []
+        with faults.plan_scope(
+            FaultPlan.parse("seed=11;scale/decision:error%0.4")
+        ):
+            for _ in range(24):
+                out.append(sc.tick() == "skipped")
+        return out
+
+    first = run_schedule()
+    second = run_schedule()
+    assert first == second
+    assert any(first) and not all(first)
+
+
+# --------------------------------------------------------- bench smoke gate --
+def test_bench_smoke_scale_trimmed(tmp_path):
+    """Tier-1-sized elastic smoke: subprocess replicas, the full
+    quiet→burst→quiet ramp with a mid-burst SIGKILL, hard-gated exactly
+    like the CI gate."""
+    import bench
+
+    result = bench.smoke_scale(str(tmp_path / "scale.jsonl"), trimmed=True)
+    assert result["ok"], result
+    assert result["dropped_responses"] == 0
+    assert result["argmax_parity"] == 1.0
+    assert result["scale_ups"] >= 1 and result["scale_downs"] >= 1
+    assert result["supervised_restarts"] >= 1 and result["restart_drilled"]
+    tl = result["replica_timeline"]
+    assert tl["quiet1_max"] == 1 and tl["burst_peak"] >= 2
+    assert tl["quiet2_end"] == 1
+
+
+@pytest.mark.slow
+def test_bench_smoke_scale_full(tmp_path):
+    import bench
+
+    result = bench.smoke_scale(str(tmp_path / "scale_full.jsonl"))
+    assert result["ok"], result
+    assert result["replica_timeline"]["burst_peak"] >= 2
+    assert result["health"]["target_replicas"] == 1

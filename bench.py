@@ -2133,6 +2133,326 @@ def smoke_scale(jsonl_path: str | None = None, *, trimmed: bool = False) -> dict
     return result
 
 
+def smoke_obs(jsonl_path: str | None = None, *, trimmed: bool = False) -> dict:
+    """CPU-safe fleet-observability smoke: the whole plane of
+    docs/OBSERVABILITY.md §14–15 under one gate.
+
+    Runs a 2-replica subprocess fleet — each worker capturing its own
+    JSONL via ``--metrics-jsonl`` while the coordinator captures its own
+    sink — with the fleet collector and SLO evaluator riding the
+    autoscaler ticks, drives concurrent traffic through one induced shed
+    burst and a trailing silence (which walks the fleet down one
+    replica), then audits the plane end to end:
+
+      * **aggregate exactness** — the collector's merged counters equal
+        the sum of its per-replica views plus the coordinator's own
+        registry, exactly, INCLUDING the scale-down victim's retained
+        terminal scrape;
+      * **stitched nesting** — the router capture plus every
+        ``replica-*.jsonl`` stitch into one Perfetto timeline, and at
+        least one request flow crosses processes (router
+        ``fleet/dispatch`` → replica ``serve/dispatch`` → runner
+        ``score``) sharing one ``trace_id`` with non-negative duration
+        slack (a child span never out-lasts its real-time parent);
+      * **burn-rate trip-and-clear** — the availability objective alerts
+        during the shed burst (``slo/alerts`` >= 1, a
+        ``slo_availability_burn`` reason on the fleet ``/healthz``) and
+        is clear again after the silence;
+      * **zero scrape failures** — ``fleet/agg_scrape_failures`` == 0
+        across every round including the terminal scrape;
+
+    plus the serving invariants every smoke holds: zero dropped
+    responses, argmax parity exactly 1.0 against the direct runner, a
+    ``server_timing``/``server`` identity block on the responses, and
+    >= 1 autoscaler scale-down. ``trimmed=True`` is the tier-1-sized
+    variant (shorter phases, same gates).
+    """
+    import glob as globmod
+    import tempfile
+    import threading
+
+    from spark_languagedetector_tpu import LanguageDetector, Table
+    from spark_languagedetector_tpu.ops.encoding import texts_to_bytes
+    from spark_languagedetector_tpu.resilience.policy import RetryPolicy
+    from spark_languagedetector_tpu.scale import Autoscaler, ElasticFleet
+    from spark_languagedetector_tpu.serve.client import ServeClient, ServeHTTPError
+    from spark_languagedetector_tpu.serve.router import RouterServer
+    from spark_languagedetector_tpu.telemetry import REGISTRY
+    from spark_languagedetector_tpu.telemetry.export import JsonlSink
+    from spark_languagedetector_tpu.telemetry.slo import (
+        SloEvaluator,
+        default_objectives,
+    )
+    from spark_languagedetector_tpu.telemetry.stitch import (
+        load_captures,
+        nesting_slack_s,
+        trace_flows,
+        write_stitched_trace,
+    )
+
+    REGISTRY.reset()
+    path = jsonl_path or os.path.join(
+        tempfile.gettempdir(), f"obs_smoke_{os.getpid()}.jsonl"
+    )
+    sink = JsonlSink(path)
+    REGISTRY.add_sink(sink)
+
+    langs = language_names(3)
+    docs, labels = make_corpus(langs, 60, mean_len=200, seed=3)
+    model = LanguageDetector(langs, [1, 2, 3], 200).fit(
+        Table({"lang": labels, "fulltext": docs})
+    )
+    runner = model._get_runner()
+    tmpdir = tempfile.mkdtemp(prefix="obs_smoke_")
+    model_dir = os.path.join(tmpdir, "model")
+    model.save(model_dir)
+    metrics_dir = os.path.join(tmpdir, "metrics")
+
+    burst_clients = 6
+    docs_per_req = 24
+    # Same throttled-admission children as --smoke-scale: the burst
+    # (clients x 24-row requests against two 48-row bounds) overruns the
+    # fleet honestly, which is what burns the availability objective.
+    child_env = {
+        "LANGDETECT_SERVE_MAX_ROWS": "24",
+        "LANGDETECT_SERVE_MAX_WAIT_MS": "25",
+        "LANGDETECT_SERVE_QUEUE_ROWS": "48",
+    }
+    # Smoke-sized SLO windows (seconds, not minutes) so the trip AND the
+    # clear both happen inside the phase script; the latency objective's
+    # threshold sits above the client timeout so only availability (the
+    # induced signal) can alert.
+    slo = SloEvaluator(
+        default_objectives(latency_p99_ms=60_000.0),
+        short_window_s=1.5, long_window_s=4.0,
+    )
+    fleet = ElasticFleet(
+        model_dir, replicas=2,
+        fleet_name=f"smoke_obs_{os.getpid()}",
+        pidfile_dir=os.path.join(tmpdir, "pids"),
+        child_env=child_env,
+        metrics_dir=metrics_dir,
+        slo=slo,
+        prewarm=True, joiner_prewarm=False,
+        router_kw=dict(
+            probe_interval_ms=40.0, breaker_threshold=2,
+            breaker_cooldown_s=0.3, probe_timeout_s=2.0,
+            drain_timeout_s=5.0,
+        ),
+    ).start()
+    scaler = Autoscaler(
+        fleet, scale_min=1, scale_max=2, interval_ms=100.0,
+        up_ticks=2, down_ticks=4, pressure_wait_ms=30.0,
+        idle_rows_per_s=20.0,
+    ).start()
+    front = RouterServer(
+        fleet.router, port=0, collector=fleet.collector, slo=fleet.slo
+    ).start()
+    host, port = front.address
+
+    lock = threading.Lock()
+    responses: list[tuple[list, list]] = []
+    errors: list[str] = []
+    meta_sample: dict = {}
+    phase = ["quiet1"]
+    stop = threading.Event()
+
+    def drive(ci: int) -> None:
+        rng = np.random.default_rng(800 + ci)
+        client = ServeClient(
+            host, port, retry_policy=RetryPolicy(
+                max_attempts=30, base_delay_s=0.05, max_delay_s=0.5,
+                seed=800 + ci,
+            ),
+        )
+        while not stop.is_set():
+            current = phase[0]
+            if current == "quiet2" or (current == "quiet1" and ci > 0):
+                # Burst clients idle outside the burst; client 0 keeps a
+                # light uncoalesced pulse through quiet1 (clean stitched
+                # flows) — quiet2 is true silence so the arrival EMA
+                # decays and the short SLO window drains.
+                time.sleep(0.05)
+                continue
+            n = docs_per_req if current == "burst" else 2
+            lo = int(rng.integers(0, len(docs) - n + 1))
+            texts = docs[lo:lo + n]
+            try:
+                got, meta = client.detect(texts)
+            except (ServeHTTPError, OSError) as e:
+                with lock:
+                    errors.append(f"client {ci} [{current}]: {e}")
+                continue
+            with lock:
+                responses.append((texts, got))
+                if not meta_sample and meta.get("server_timing"):
+                    meta_sample.update({
+                        "server_timing": meta.get("server_timing"),
+                        "server": meta.get("server"),
+                    })
+            if current == "quiet1":
+                time.sleep(0.04)
+
+    threads = [
+        threading.Thread(target=drive, args=(ci,))
+        for ci in range(burst_clients)
+    ]
+    for t in threads:
+        t.start()
+
+    def counter(name: str) -> int:
+        return int(REGISTRY.snapshot()["counters"].get(name, 0))
+
+    def wait_for(pred, timeout_s: float) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(0.05)
+        return pred()
+
+    burn_reasons: list[str] = []
+    burn_tripped = burn_cleared = scaled_down = False
+    try:
+        time.sleep(1.0 if trimmed else 2.0)
+        phase[0] = "burst"
+        # Burst until the availability objective demonstrably alerts —
+        # sheds are the induced error budget burn.
+        burn_tripped = wait_for(lambda: counter("slo/alerts") >= 1, 60.0)
+        if burn_tripped:
+            burn_reasons = list(fleet.healthz().get("reasons") or [])
+        time.sleep(0.3)
+        phase[0] = "quiet2"
+        # Silence: the short window drains (the alert clears), the
+        # arrival EMA decays, and the fleet walks down one replica —
+        # whose terminal scrape the collector must retain.
+        burn_cleared = wait_for(lambda: not fleet.slo.burning(), 30.0)
+        scaled_down = wait_for(
+            lambda: counter("scale/downs") >= 1
+            and fleet.live_count() == 1,
+            90.0,
+        )
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        scaler.close()
+        final_health = fleet.healthz()
+        front.stop()
+        fleet.close()
+
+    # Parity: every response label-exact against the direct runner,
+    # across both replicas and the scale-down.
+    checked = mismatches = 0
+    for texts, got in responses:
+        ids = runner.predict_ids(texts_to_bytes(texts))
+        want = [langs[int(i)] for i in ids]
+        checked += 1
+        if got != want:
+            mismatches += 1
+    parity = 1.0 if checked and mismatches == 0 else (
+        round(1.0 - mismatches / checked, 6) if checked else 0.0
+    )
+
+    # Gate: aggregate ≡ per-replica views + the coordinator's registry,
+    # exactly. Everything is quiescent post-close, so both reads see the
+    # same counters; the retained (drained) member must participate.
+    agg_counters = fleet.collector.aggregate()["counters"]
+    per = fleet.collector.per_replica()
+    local = REGISTRY.mergeable_snapshot()["counters"]
+    expect: dict[str, float] = {}
+    for view in per.values():
+        for cname, val in view["counters"].items():
+            expect[cname] = expect.get(cname, 0) + val
+    for cname, val in local.items():
+        expect[cname] = expect.get(cname, 0) + val
+    aggregate_exact = set(expect) == set(agg_counters) and all(
+        expect[cname] == agg_counters[cname] for cname in expect
+    )
+    retained = [
+        name for name, view in per.items()
+        if view["state"] == "retired"
+        and sum(view["counters"].values()) > 0
+    ]
+
+    # Gate: stitched timeline + a complete cross-process request flow
+    # with non-negative nesting slack. A flow with a slack at all has
+    # router+replica+runner spans under ONE trace_id, and those spans
+    # can only come from different captures.
+    replica_logs = sorted(
+        globmod.glob(os.path.join(metrics_dir, "replica-*.jsonl"))
+    )
+    stitched_path = write_stitched_trace(
+        [path] + replica_logs,
+        os.path.join(tmpdir, "stitched.trace.json"),
+    )
+    flows = trace_flows(load_captures([path] + replica_logs))
+    cross_flows = 0
+    best_slack: float | None = None
+    for spans in flows.values():
+        if len({s["process"] for s in spans}) > 1:
+            cross_flows += 1
+        slack = nesting_slack_s(spans)
+        if slack is not None and (best_slack is None or slack > best_slack):
+            best_slack = slack
+
+    snap = REGISTRY.snapshot()
+    counters = snap["counters"]
+    result = {
+        "smoke_obs": True,
+        "trimmed": trimmed,
+        "replicas": 2,
+        "answered": len(responses),
+        "dropped_responses": len(errors),
+        "errors": errors[:5],
+        "argmax_parity": parity,
+        "server_timing_sample": meta_sample.get("server_timing"),
+        "server_identity_sample": meta_sample.get("server"),
+        "slo_alerts": int(counters.get("slo/alerts", 0)),
+        "burn_reasons": burn_reasons,
+        "burn_cleared": burn_cleared,
+        "final_burning": bool(final_health["slo"]["burning"]),
+        "scale_downs": int(counters.get("scale/downs", 0)),
+        "scaled_down": scaled_down,
+        "agg_scrapes": int(counters.get("fleet/agg_scrapes", 0)),
+        "agg_scrape_failures": int(
+            counters.get("fleet/agg_scrape_failures", 0)
+        ),
+        "aggregate_exact": aggregate_exact,
+        "aggregate_counter_names": len(agg_counters),
+        "retained_members": retained,
+        "replica_captures": [os.path.basename(p) for p in replica_logs],
+        "stitched_trace": stitched_path,
+        "trace_flows": len(flows),
+        "cross_process_flows": cross_flows,
+        "nesting_slack_s": best_slack,
+        "telemetry": telemetry_block(path),
+    }
+    result["ok"] = bool(
+        not errors
+        and parity == 1.0
+        and meta_sample.get("server_timing") is not None
+        and (meta_sample.get("server") or {}).get("replica") is not None
+        and burn_tripped
+        and result["slo_alerts"] >= 1
+        and "slo_availability_burn" in burn_reasons
+        and burn_cleared
+        and not result["final_burning"]
+        and scaled_down
+        and result["scale_downs"] >= 1
+        and result["agg_scrapes"] >= 1
+        and result["agg_scrape_failures"] == 0
+        and aggregate_exact
+        and retained
+        and len(replica_logs) >= 2
+        and cross_flows >= 1
+        and best_slack is not None
+        and best_slack >= 0.0
+    )
+    REGISTRY.remove_sink(sink)
+    return result
+
+
 def smoke_refit(jsonl_path: str | None = None) -> dict:
     """CPU-safe continuous-learning smoke: the full data-in → model-out →
     serving loop under one gate (ROADMAP item 2).
@@ -4209,6 +4529,37 @@ def main():
                     "; ".join(result["errors"])
                     or "gate (ramp-up/ramp-down/restart/drop/parity) "
                     "not met"
+                ),
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        return
+    if "--smoke-obs" in sys.argv[1:]:
+        # Fleet-observability smoke path: 2 subprocess replicas with
+        # per-process JSONL captures, the collector + SLO evaluator on
+        # the autoscaler ticks, one induced shed burst, one scale-down.
+        # Gates: aggregate == sum of per-replica scrapes (exact, incl.
+        # the drained member), a stitched cross-process flow with
+        # non-negative nesting slack, burn-rate trip AND clear, zero
+        # scrape failures, zero drops, parity 1.0.
+        args = [a for a in sys.argv[1:] if a != "--smoke-obs"]
+        flags = [a for a in args if a.startswith("-")]
+        if flags or len(args) > 1:
+            print(
+                f"usage: python bench.py --smoke-obs [out.jsonl] "
+                f"(got {args})",
+                file=sys.stderr,
+            )
+            sys.exit(2)
+        result = smoke_obs(args[0] if args else None)
+        print(json.dumps(result), flush=True)
+        if not result["ok"]:
+            print(
+                "obs smoke FAILED: "
+                + (
+                    "; ".join(result["errors"])
+                    or "gate (aggregate-exact/stitch/burn-trip-clear/"
+                    "scrape-failures/drop/parity) not met"
                 ),
                 file=sys.stderr,
             )

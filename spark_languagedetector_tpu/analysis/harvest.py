@@ -471,6 +471,43 @@ def compare_contracts(compare: PyFile | None) -> CompareContracts:
     return out
 
 
+@dataclass
+class FleetContracts:
+    """Names the fleet observability plane consumes (docs/OBSERVABILITY.md
+    §14): counters the aggregate's pressure readers sum, the collector's
+    own guard counters (which must stay pinned in ``telemetry/compare``'s
+    tables), and the SLO layer's burn-rate inputs."""
+
+    consumed_counters: dict[str, int] = field(default_factory=dict)
+    guard_counters: dict[str, int] = field(default_factory=dict)
+    slo_counters: dict[str, int] = field(default_factory=dict)
+    slo_histograms: dict[str, int] = field(default_factory=dict)
+    slo_gauges: dict[str, int] = field(default_factory=dict)
+
+
+def fleet_contracts(
+    aggregate: PyFile | None, slo: PyFile | None
+) -> FleetContracts:
+    """Contract tables from ``telemetry/aggregate`` + ``telemetry/slo``."""
+    out = FleetContracts()
+
+    def pull(pf: PyFile | None, const: str, table: dict[str, int]) -> None:
+        if pf is None or pf.tree is None:
+            return
+        node = _module_assign(pf, const)
+        if node is None:
+            return
+        for s in _str_elements(node, pf.consts):
+            table[s] = node.lineno
+
+    pull(aggregate, "CONSUMED_COUNTERS", out.consumed_counters)
+    pull(aggregate, "GUARD_COUNTERS", out.guard_counters)
+    pull(slo, "SLO_INPUT_COUNTERS", out.slo_counters)
+    pull(slo, "SLO_INPUT_HISTOGRAMS", out.slo_histograms)
+    pull(slo, "SLO_INPUT_GAUGES", out.slo_gauges)
+    return out
+
+
 def tune_consumed(tune: PyFile | None) -> dict[str, tuple[int, str, bool]]:
     """Capture names ``exec/tune`` replays: ``{name: (line, kind, prefix)}``.
 

@@ -21,6 +21,8 @@ CONFIG_REL = "exec/config.py"
 COMPARE_REL = "telemetry/compare.py"
 TUNE_REL = "exec/tune.py"
 FAULTS_REL = "resilience/faults.py"
+AGGREGATE_REL = "telemetry/aggregate.py"
+SLO_REL = "telemetry/slo.py"
 OBSERVABILITY_DOC = "docs/OBSERVABILITY.md"
 RESILIENCE_DOC = "docs/RESILIENCE.md"
 
@@ -310,6 +312,73 @@ def check_telemetry_names(scan: Scan) -> list[Violation]:
                 f"tune replays {kind} {name!r} no code emits",
                 "the autotuner's input signal must be recorded somewhere "
                 "— emit it or stop consuming it",
+            ))
+
+    # --- fleet observability plane (aggregate + slo) ----------------------
+    # The cross-process surface: names the collector's pressure readers
+    # sum and the SLO layer differentiates live in other processes, so a
+    # rename at the emit site would silently zero the autoscaler's
+    # pressure signal rather than crash anything. Same treatment as the
+    # compare/tune tables above — consumed names must be emitted — plus
+    # one extra bolt: the collector's guard counters must stay pinned in
+    # compare's tables, or a scrape-failure regression stops gating.
+    fc = harvest.fleet_contracts(
+        scan.files.get(AGGREGATE_REL), scan.files.get(SLO_REL)
+    )
+    for name, line in sorted(fc.consumed_counters.items()):
+        if not em.counter(name):
+            out.append(Violation(
+                "R2", AGGREGATE_REL, line,
+                f"fleet aggregate consumes counter {name!r} no code emits",
+                "the collector sums this across scraped replicas and the "
+                "autoscaler sheds-pressure reads it — emit it via "
+                "REGISTRY.incr or drop it from CONSUMED_COUNTERS",
+            ))
+    for name, line in sorted(fc.slo_counters.items()):
+        if not em.counter(name):
+            out.append(Violation(
+                "R2", SLO_REL, line,
+                f"SLO objective consumes counter {name!r} no code emits",
+                "a burn rate over a never-emitted counter is identically "
+                "zero — emit it or drop it from SLO_INPUT_COUNTERS",
+            ))
+    for name, line in sorted(fc.slo_histograms.items()):
+        if not em.hist(name):
+            out.append(Violation(
+                "R2", SLO_REL, line,
+                f"SLO objective consumes histogram {name!r} no code emits",
+                "emit it via REGISTRY.observe or drop it from "
+                "SLO_INPUT_HISTOGRAMS",
+            ))
+    for name, line in sorted(fc.slo_gauges.items()):
+        if not em.gauge(name):
+            out.append(Violation(
+                "R2", SLO_REL, line,
+                f"SLO objective consumes gauge {name!r} no code emits",
+                "emit it via REGISTRY.set_gauge or drop it from "
+                "SLO_INPUT_GAUGES",
+            ))
+    compare_tracked = set(cc.reliability_counters) | set(
+        cc.informational_counters
+    )
+    for name, line in sorted(fc.guard_counters.items()):
+        if not em.counter(name):
+            out.append(Violation(
+                "R2", AGGREGATE_REL, line,
+                f"collector guard counter {name!r} is never emitted",
+                "emit it via REGISTRY.incr or drop it from GUARD_COUNTERS",
+            ))
+        if name not in compare_tracked and not any(
+            name.startswith(p) for p in cc.reliability_prefixes
+        ):
+            out.append(Violation(
+                "R2", AGGREGATE_REL, line,
+                f"collector guard counter {name!r} is not tracked by "
+                f"{COMPARE_REL}",
+                "pin it in _RELIABILITY_COUNTERS (gates regressions) or "
+                "_INFORMATIONAL_COUNTERS (operator signal) — an untracked "
+                "guard counter can appear against a clean baseline "
+                "without compare noticing",
             ))
 
     # --- grammar ----------------------------------------------------------

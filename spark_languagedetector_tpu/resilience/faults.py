@@ -82,6 +82,13 @@ Sites (one hook per serving layer; docs/RESILIENCE.md §4):
     skips that one tick entirely — fail-static, never a wrong scale
     action — counted as ``scale/decision_skips``; ``%prob`` plans
     replay the same skipped ticks for a given seed, like ``fleet/*``.
+  * ``fleet/scrape``   — each ``/telemetryz`` scrape of one member by
+    the fleet collector (:meth:`scale.elastic.ElasticFleet.
+    collect_telemetry`): a firing ``error`` fails that one scrape —
+    counted as ``fleet/agg_scrape_failures``, never propagated into
+    the tick loop — so the aggregate-staleness (SLO freshness) and
+    scrape-failure-regression paths replay deterministically
+    (docs/OBSERVABILITY.md §14).
 """
 
 from __future__ import annotations
@@ -114,6 +121,7 @@ SITES = (
     "zoo/load",
     "scale/spawn",
     "scale/decision",
+    "fleet/scrape",
 )
 
 KINDS = ("error", "delay", "poison")

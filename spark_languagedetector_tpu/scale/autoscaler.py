@@ -53,11 +53,15 @@ class ScaleSignals:
     summed dispatch-throughput EMAs); ``shed_delta`` is new sheds since
     the previous snapshot — appearance, not level, is the pressure
     signal (a counter's absolute value only says the fleet has history).
+    ``shed_delta`` is differentiated from the fleet telemetry aggregate
+    (the collector's monotone counters), and ``slo_burning`` carries the
+    burn-rate verdict over the same aggregate — a burning objective is
+    pressure even before sheds appear (a sustained p99 breach, say).
     """
 
     __slots__ = (
         "live", "ready", "queued_rows", "inflight_rows", "ema_rows_per_s",
-        "est_wait_ms", "shed_delta", "breaker_open",
+        "est_wait_ms", "shed_delta", "breaker_open", "slo_burning",
     )
 
     def __init__(
@@ -71,6 +75,7 @@ class ScaleSignals:
         est_wait_ms: float = 0.0,
         shed_delta: int = 0,
         breaker_open: bool = False,
+        slo_burning: bool = False,
     ):
         self.live = live
         self.ready = ready
@@ -80,6 +85,7 @@ class ScaleSignals:
         self.est_wait_ms = est_wait_ms
         self.shed_delta = shed_delta
         self.breaker_open = breaker_open
+        self.slo_burning = slo_burning
 
     def describe(self) -> dict:
         return {s: getattr(self, s) for s in self.__slots__}
@@ -167,8 +173,10 @@ class Autoscaler:
                 "langdetect_fleet_target_replicas", float(self.scale_min)
             )
             return "up"
-        pressure = sig.shed_delta > 0 or (
-            sig.est_wait_ms >= self.pressure_wait_ms
+        pressure = (
+            sig.shed_delta > 0
+            or sig.est_wait_ms >= self.pressure_wait_ms
+            or sig.slo_burning
         )
         # Idleness explicitly excludes pressure: a tick that shows SLO
         # pressure can never ALSO count toward the scale-down cooldown,

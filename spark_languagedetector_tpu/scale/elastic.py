@@ -22,16 +22,21 @@ this class owns the mapping between them:
 
 :meth:`signals` aggregates the per-replica admission-queue stats (the
 ``/healthz`` batcher block: queued/in-flight rows, the admitted-rows
-odometer, the dispatch-throughput EMA, shed tallies) plus the router's
-fleet-wide shed counter into one :class:`~.autoscaler.ScaleSignals`
-snapshot — the autoscaler's entire view of the world. The fleet
+odometer, the dispatch-throughput EMA) into one
+:class:`~.autoscaler.ScaleSignals` snapshot — and each round it also
+**scrapes every live member's ``/telemetryz``** into the fleet's
+:class:`~..telemetry.aggregate.FleetCollector` (fault site
+``fleet/scrape``), so the autoscaler's shed pressure is differentiated
+from the *fleet aggregate* (replica-side ``serve/shed_requests`` summed
+with router-side ``fleet/shed_requests``, terminal scrapes included) —
+monotone across restarts and scale-downs by the collector's generation
+folding, so no per-member clamping is needed. The aggregate also feeds
+the :class:`~..telemetry.slo.SloEvaluator` each round; a burning
+objective is an additional scale-up pressure signal. The fleet
 **arrival-rate EMA** is differentiated here, coordinator-side, from the
 admitted-rows odometers (the same 0.7/0.3 fold the admission queue uses
 for its dispatch EMA), so it genuinely decays to zero across silence —
-which is what makes the scale-down idleness test honest. Restarted
-replicas reset their counters to zero; the per-member delta tracking
-clamps at zero so a restart never reads as negative shedding or
-negative arrivals.
+which is what makes the scale-down idleness test honest.
 """
 
 from __future__ import annotations
@@ -40,9 +45,12 @@ import threading
 import time
 
 from ..exec import config as exec_config
+from ..resilience import faults
 from ..serve.client import ServeClient
 from ..serve.router import FleetRouter
 from ..telemetry import REGISTRY
+from ..telemetry.aggregate import FleetCollector
+from ..telemetry.slo import SloEvaluator
 from ..utils.logging import get_logger, log_event
 from .autoscaler import ScaleSignals
 from .replica import ReplicaSupervisor
@@ -74,12 +82,14 @@ class ElasticFleet:
         spawn_timeout_s: float | None = None,
         stats_timeout_s: float = 2.0,
         child_env: dict | None = None,
+        metrics_dir: str | None = None,
+        slo: SloEvaluator | None = None,
     ):
         self.supervisor = ReplicaSupervisor(
             model_path, host=host, platform=platform,
             fleet_name=fleet_name, pidfile_dir=pidfile_dir,
             prewarm=prewarm, spawn_timeout_s=spawn_timeout_s,
-            child_env=child_env,
+            child_env=child_env, metrics_dir=metrics_dir,
         )
         self._host = host
         # Scale-up joiners may come up cold (compile folded into their
@@ -96,12 +106,20 @@ class ElasticFleet:
         self.router = FleetRouter(members, **(router_kw or {}))
         self.target = initial
         self._stats_clients: dict[str, ServeClient] = {}
-        # Per-member shed/arrival baselines (restart-aware) + the
-        # router-side fleet shed baseline: delta, not level, is the
-        # pressure signal.
-        self._shed_seen: dict[str, int] = {}
+        # The fleet observability plane (docs/OBSERVABILITY.md §14): the
+        # collector accumulates every member's /telemetryz (terminal
+        # scrapes retained across scale-downs and restarts), the SLO
+        # evaluator rides its aggregate. Both attach to the RouterServer
+        # front for /varz + /healthz.
+        self.collector = FleetCollector(local_name="router")
+        self.slo = SloEvaluator() if slo is None else slo
+        # Per-member arrival baselines (restart-aware) + the aggregate
+        # shed baseline: delta, not level, is the pressure signal. The
+        # aggregate is monotone by collector construction, but the
+        # coordinator's process-global registry may carry counts from an
+        # earlier fleet in this process — baseline them away.
         self._admitted_seen: dict[str, int] = {}
-        self._fleet_sheds_seen = self._fleet_sheds()
+        self._agg_sheds_seen = self._aggregate_sheds()
         self._arrival_ema: float | None = None
         self._last_signals_t: float | None = None
         REGISTRY.set_gauge(
@@ -182,8 +200,20 @@ class ElasticFleet:
         self.router.remove_replica(victim, drain=True)
         self.target -= 1
         REGISTRY.incr("scale/downs")
+        # Terminal scrape between the router drain (every routed request
+        # answered, counters final) and the child's exit: the victim's
+        # telemetry folds into the collector's retained base, so the
+        # scale-down loses no counters (the worker's own exit-flush into
+        # its JSONL capture is the belt to this suspender).
+        rep = self.supervisor.members.get(victim)
+        if rep is not None and rep.alive:
+            host, port = rep.address
+            self.collector.scrape(
+                victim,
+                self._member_client(victim, host, port).telemetryz,
+            )
+        self.collector.retire(victim)
         self._stats_clients.pop(victim, None)
-        self._shed_seen.pop(victim, None)
         self._admitted_seen.pop(victim, None)
         try:
             self.supervisor.stop(victim, drain=True)
@@ -231,8 +261,10 @@ class ElasticFleet:
                 self.supervisor.forget(name)
                 with self._scale_lock:
                     self.target = max(0, self.target - 1)
+                # The process is gone (no farewell scrape possible);
+                # retiring retains whatever its last scrape carried.
+                self.collector.retire(name)
                 self._stats_clients.pop(name, None)
-                self._shed_seen.pop(name, None)
                 self._admitted_seen.pop(name, None)
         if events:
             REGISTRY.set_gauge(
@@ -241,12 +273,42 @@ class ElasticFleet:
         return events
 
     # -------------------------------------------------------------- signals --
-    def _fleet_sheds(self) -> int:
-        # Direct dict read, not REGISTRY.snapshot(): a snapshot sorts
-        # every histogram reservoir under the registry's global lock —
-        # far too heavy for a value read once per autoscaler tick. A
-        # bare dict.get on the counters table is GIL-atomic.
-        return int(REGISTRY.counters.get("fleet/shed_requests", 0))
+    def _aggregate_sheds(self) -> float:
+        # The fleet-aggregate shed odometer: replica-side admission sheds
+        # plus router-side routing sheds, summed out of the collector
+        # (retained generations + live scrapes + the coordinator's own
+        # registry). Monotone by construction, so the pressure delta is
+        # a plain subtraction — no per-member restart clamping.
+        return (
+            self.collector.counter("fleet/shed_requests")
+            + self.collector.counter("serve/shed_requests")
+        )
+
+    def collect_telemetry(self) -> None:
+        """Scrape every live member's ``/telemetryz`` into the collector
+        (one round of the fleet observability plane; rides every
+        :meth:`signals` call). Each scrape runs under the
+        ``fleet/scrape`` fault site — an injected failure is counted
+        (``fleet/agg_scrape_failures``) exactly like a real mid-death
+        member, never propagated into the tick loop."""
+        with self.supervisor._lock:
+            members = [
+                (name, rep)
+                for name, rep in self.supervisor.members.items()
+                if name not in self.supervisor._retired
+            ]
+        for name, rep in members:
+            if not rep.alive:
+                continue
+            host, port = rep.address
+            client = self._member_client(name, host, port)
+
+            def fetch(client=client):
+                faults.inject("fleet/scrape")
+                return client.telemetryz()
+
+            self.collector.scrape(name, fetch)
+        self.collector.freshness_s()
 
     def _member_client(self, name: str, host: str, port: int) -> ServeClient:
         client = self._stats_clients.get(name)
@@ -262,7 +324,11 @@ class ElasticFleet:
         from the admitted-rows odometers, so it decays across silence);
         ``est_wait_ms`` is backlog over the summed per-replica dispatch-
         throughput EMAs — the same estimate each admission queue sheds
-        on, fleet-wide."""
+        on, fleet-wide. ``shed_delta`` differentiates the **fleet
+        telemetry aggregate** (one scrape round runs first), and
+        ``slo_burning`` carries the burn-rate verdict over the same
+        aggregate."""
+        self.collect_telemetry()
         with self.supervisor._lock:
             members = [
                 (name, rep) for name, rep in self.supervisor.members.items()
@@ -271,7 +337,6 @@ class ElasticFleet:
         live = 0
         queued = inflight = 0
         service_ema = 0.0
-        shed_delta = 0
         arrivals = 0
         for name, rep in members:
             if not rep.alive:
@@ -286,22 +351,22 @@ class ElasticFleet:
             queued += int(stats.get("queued_rows", 0))
             inflight += int(stats.get("inflight_rows", 0))
             service_ema += float(stats.get("ema_rows_per_s", 0.0))
-            # A restarted child restarts its counters: clamp each delta
-            # at zero (well, at the fresh count) so the reset never
-            # reads as negative shedding or negative arrivals.
-            sheds = int(stats.get("shed_requests", 0))
-            seen = self._shed_seen.get(name, 0)
-            shed_delta += sheds - seen if sheds >= seen else sheds
-            self._shed_seen[name] = sheds
+            # A restarted child restarts its odometer: clamp the delta
+            # at the fresh count so the reset never reads as negative
+            # arrivals. (Shed deltas no longer need this dance — the
+            # collector's generation folding keeps the aggregate
+            # monotone.)
             admitted = int(stats.get("admitted_rows", 0))
             seen_rows = self._admitted_seen.get(name, 0)
             arrivals += (
                 admitted - seen_rows if admitted >= seen_rows else admitted
             )
             self._admitted_seen[name] = admitted
-        fleet_sheds = self._fleet_sheds()
-        shed_delta += max(0, fleet_sheds - self._fleet_sheds_seen)
-        self._fleet_sheds_seen = fleet_sheds
+        agg_sheds = self._aggregate_sheds()
+        shed_delta = max(0, int(agg_sheds - self._agg_sheds_seen))
+        self._agg_sheds_seen = agg_sheds
+        aggregate = self.collector.aggregate()
+        slo_status = self.slo.ingest(aggregate)
         now = time.monotonic()
         if self._last_signals_t is not None and now > self._last_signals_t:
             rate = arrivals / (now - self._last_signals_t)
@@ -326,6 +391,7 @@ class ElasticFleet:
             ),
             shed_delta=shed_delta,
             breaker_open=breaker_open,
+            slo_burning=bool(slo_status.get("burning")),
         )
         REGISTRY.set_gauge("langdetect_fleet_live_replicas", float(live))
         return sig
@@ -336,4 +402,14 @@ class ElasticFleet:
         out["target_replicas"] = self.target
         out["live_replicas"] = self.live_count()
         out["pidfile_dir"] = self.supervisor.pidfile_dir
+        slo = self.slo.status()
+        out["slo"] = slo
+        if slo["burning"]:
+            out["reasons"] = list(out.get("reasons") or []) + slo["reasons"]
+        out["telemetry"] = {
+            "members": self.collector.members(),
+            "scrapes": self.collector.scrapes,
+            "scrape_failures": self.collector.scrape_failures,
+            "freshness_s": round(self.collector.freshness_s(), 3),
+        }
         return out

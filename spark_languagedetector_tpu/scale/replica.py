@@ -91,6 +91,7 @@ class ProcessReplica:
         prewarm: bool = True,
         spawn_timeout_s: float | None = None,
         tail_lines: int = 40,
+        metrics_jsonl: str | None = None,
     ):
         self.name = name
         self.model_path = str(model_path)
@@ -100,6 +101,12 @@ class ProcessReplica:
         self._xla_flags = xla_flags
         self._env = dict(env or {})
         self._prewarm = prewarm
+        self._metrics_jsonl = metrics_jsonl
+        # Coordinator clock − child clock, measured at the READY
+        # handshake (the child stamps its wall clock onto the READY
+        # line). The stitch CLI uses the clock_sync event this emits to
+        # align per-process captures onto one timeline.
+        self.clock_offset_s: float | None = None
         self.spawn_timeout_s = float(exec_config.resolve(
             "scale_spawn_timeout_s", spawn_timeout_s
         ))
@@ -167,6 +174,8 @@ class ProcessReplica:
         ]
         if not self._prewarm:
             argv.append("--no-prewarm")
+        if self._metrics_jsonl:
+            argv += ["--metrics-jsonl", self._metrics_jsonl]
         # Fresh per-spawn state, CAPTURED by this spawn's reader thread:
         # a stale reader from the previous incarnation (never joined —
         # it may be blocked on a half-dead pipe) still holds the OLD
@@ -205,6 +214,21 @@ class ProcessReplica:
                 )
         info = json.loads(ready_line[0][len(READY_PREFIX):])
         self._port = int(info["port"])
+        # Clock sync at the handshake: the child stamped its wall clock
+        # onto the READY line *just* before we read it, so the difference
+        # is the cross-process clock offset (± pipe latency, microseconds
+        # on one host). Emitted into the coordinator's own capture —
+        # telemetry.stitch reads it back to align the timelines; a
+        # restart re-emits, so the last sync per replica stays current.
+        child_ts = info.get("ts")
+        if isinstance(child_ts, (int, float)):
+            self.clock_offset_s = time.time() - float(child_ts)
+            REGISTRY.emit({
+                "event": "telemetry.clock_sync", "ts": time.time(),
+                "replica": self.name, "pid": info.get("pid"),
+                "platform": info.get("platform"),
+                "offset_s": self.clock_offset_s,
+            })
         log_event(
             _log, "scale.replica.ready", replica=self.name, pid=self.pid,
             port=self._port, version=info.get("version"),
@@ -328,12 +352,20 @@ class ReplicaSupervisor:
         prewarm: bool = True,
         retry_policy: RetryPolicy | None = None,
         child_env: dict | None = None,
+        metrics_dir: str | None = None,
     ):
         self.model_path = str(model_path)
         self._host = host
         self._platform = platform
         self._child_env = dict(child_env or {})
         self.fleet_name = fleet_name
+        # When set, every member writes its telemetry JSONL capture to
+        # metrics_dir/replica-<name>.jsonl (append mode — restart
+        # generations share the file, distinguishable by pid), the
+        # per-process half of the stitch CLI's input.
+        self.metrics_dir = None if metrics_dir is None else str(metrics_dir)
+        if self.metrics_dir:
+            os.makedirs(self.metrics_dir, exist_ok=True)
         dirpath = exec_config.resolve("scale_pidfile_dir", pidfile_dir)
         if dirpath is None:
             import tempfile
@@ -453,6 +485,10 @@ class ReplicaSupervisor:
             platform=self._platform,
             prewarm=self._prewarm if prewarm is None else prewarm,
             spawn_timeout_s=self._spawn_timeout_s, env=self._child_env,
+            metrics_jsonl=(
+                os.path.join(self.metrics_dir, f"replica-{name}.jsonl")
+                if self.metrics_dir else None
+            ),
         )
         self._spawn_with_backoff(rep)
         with self._lock:
@@ -612,6 +648,7 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--platform", default="cpu")
     parser.add_argument("--no-prewarm", action="store_true")
+    parser.add_argument("--metrics-jsonl", default=None)
     args = parser.parse_args(argv)
 
     # Pin this process's devices BEFORE any model load touches the
@@ -624,6 +661,15 @@ def main(argv: list[str] | None = None) -> int:
 
     from ..serve.registry import ModelRegistry
     from ..serve.server import ServingServer
+    from ..telemetry.aggregate import install_process_identity
+
+    # Identity before any span fires: every record this process exports
+    # carries who recorded it (replica name, pid, live backend).
+    identity = install_process_identity(replica=args.name)
+    if args.metrics_jsonl:
+        from ..telemetry.export import JsonlSink
+
+        REGISTRY.add_sink(JsonlSink(args.metrics_jsonl))
 
     registry = ModelRegistry()
     registry.load(args.model_dir, prewarm=not args.no_prewarm)
@@ -634,7 +680,11 @@ def main(argv: list[str] | None = None) -> int:
         "port": server.address[1],
         "pid": os.getpid(),
         "version": registry.current_version(),
-        "platform": args.platform,
+        "platform": identity.get("platform", args.platform),
+        # The child's wall clock at handshake — the coordinator
+        # differences it against its own to sync the two captures
+        # (telemetry.stitch).
+        "ts": time.time(),
     }
     print(READY_PREFIX + json.dumps(ready), flush=True)
 
@@ -651,6 +701,15 @@ def main(argv: list[str] | None = None) -> int:
         pass
     finally:
         server.stop(drain=True)
+        # Final telemetry flush AFTER the drain: the snapshot event this
+        # appends to the capture is the process's terminal state —
+        # every answered request counted — so a scale-down or restart
+        # loses no telemetry even if the coordinator's last HTTP scrape
+        # raced the teardown.
+        try:
+            REGISTRY.flush()
+        except Exception:
+            pass
     return 0
 
 

@@ -122,6 +122,10 @@ class ServeResult:
     queue_wait_s: float
     dispatch_s: float
     languages: tuple[str, ...] | None = None
+    # How many rows the dispatch that served this request coalesced in
+    # total (its own included) — the server_timing block's attribution
+    # for "my latency was someone else's batch".
+    rows_coalesced: int = 0
 
     @property
     def scores(self) -> np.ndarray:
@@ -737,6 +741,7 @@ class ContinuousBatcher:
                 queue_wait_s=queue_wait_s,
                 dispatch_s=dispatch_s,
                 languages=getattr(entry, "languages", None),
+                rows_coalesced=rows,
             ))
         log_event(
             _log, "serve.dispatch", rows=rows, requests=len(live),

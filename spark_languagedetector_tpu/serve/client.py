@@ -191,6 +191,7 @@ class ServeClient:
         *,
         priority: str = "interactive",
         deadline_ms: float | None = None,
+        trace_id: str | None = None,
         tenant: str | None = None,
     ) -> tuple[list, dict]:
         """(predicted labels, response metadata). When the served model's
@@ -201,6 +202,8 @@ class ServeClient:
         payload: dict = {"texts": list(texts), "priority": priority}
         if deadline_ms is not None:
             payload["deadline_ms"] = deadline_ms
+        if trace_id is not None:
+            payload["trace_id"] = trace_id
         self._tenant_key(payload, tenant)
         data = self._request("POST", "/detect", payload, idempotent=True)
         if "results" in data:
@@ -263,6 +266,13 @@ class ServeClient:
 
     def varz(self) -> dict:
         return self._request("GET", "/varz")
+
+    def telemetryz(self) -> dict:
+        """The server's mergeable telemetry snapshot (the fleet
+        collector's scrape transport). Never retried: a scrape wants the
+        registry state *now*, and the collector already counts failures
+        (``fleet/agg_scrape_failures``)."""
+        return self._request("GET", "/telemetryz", idempotent=False)
 
     def swap(
         self,

@@ -60,6 +60,7 @@ from ..exec import config as exec_config
 from ..resilience import faults
 from ..resilience.policy import CLOSED, HALF_OPEN, OPEN, CircuitBreaker, is_retryable
 from ..telemetry import REGISTRY, span
+from ..telemetry.tracing import trace_request
 from ..utils.logging import get_logger, log_event
 from .batcher import INTERACTIVE, LANES, ServeError, ServeOverloaded
 from .client import ServeClient, ServeHTTPError
@@ -496,6 +497,35 @@ class FleetRouter:
         saturated: list[float] = []
         t0 = time.perf_counter()
         attempt = 0
+        # One trace id per routed request, minted here when the caller
+        # didn't bring one: the router's fleet/dispatch span and the
+        # winning replica's serve spans all stamp the SAME id, which is
+        # what lets the stitcher join one request across process captures
+        # (docs/OBSERVABILITY.md §14).
+        with trace_request(trace_id) as tid:
+            return self._dispatch_traced(
+                texts, rows=rows, excluded=excluded, saturated=saturated,
+                t0=t0, attempt=attempt, want_labels=want_labels,
+                segment_kw=segment_kw, priority=priority,
+                deadline_ms=deadline_ms, trace_id=tid, tenant=tenant,
+            )
+
+    def _dispatch_traced(
+        self,
+        texts: list,
+        *,
+        rows: int,
+        excluded: set,
+        saturated: list,
+        t0: float,
+        attempt: int,
+        want_labels: bool,
+        segment_kw: dict | None,
+        priority: str,
+        deadline_ms: float | None,
+        trace_id: str,
+        tenant: str | None,
+    ):
         while attempt < self.dispatch_attempts:
             h = self._pick(rows, excluded)
             if h is None:
@@ -520,7 +550,8 @@ class FleetRouter:
                     elif want_labels:
                         out, meta = h.client.detect(
                             texts, priority=priority,
-                            deadline_ms=deadline_ms, tenant=tenant,
+                            deadline_ms=deadline_ms, trace_id=trace_id,
+                            tenant=tenant,
                         )
                     else:
                         out, meta = h.client.score(
@@ -681,10 +712,19 @@ class RouterServer(JsonHTTPFront):
         host: str = "127.0.0.1",
         port: int = 8000,
         admin: bool = True,
+        collector=None,
+        slo=None,
     ):
         self.router = router
         self.fleet = fleet
         self.admin = admin
+        # Optional observability plane (docs/OBSERVABILITY.md §14): an
+        # elastic fleet hands its FleetCollector + SloEvaluator in so the
+        # fleet /varz serves the merged aggregate and /healthz carries
+        # the burn-rate verdicts. Both default to the elastic fleet's own
+        # instances when started via ElasticFleet.
+        self.collector = collector
+        self.slo = slo
         super().__init__(host, port)
 
     # ---------------------------------------------------------- handlers ----
@@ -781,6 +821,23 @@ class RouterServer(JsonHTTPFront):
         out = self.router.healthz()
         out["draining"] = self._draining
         out["uptime_s"] = round(time.monotonic() - self._started, 3)
+        if self.slo is not None:
+            # Burn-rate verdicts join the fleet's reasons surface: a
+            # burning objective is an operator-facing "why is this
+            # unhealthy" even while routing still succeeds.
+            slo = self.slo.status()
+            out["slo"] = slo
+            if slo["burning"]:
+                out["reasons"] = (
+                    list(out.get("reasons") or []) + slo["reasons"]
+                )
+        if self.collector is not None:
+            out["telemetry"] = {
+                "members": self.collector.members(),
+                "scrapes": self.collector.scrapes,
+                "scrape_failures": self.collector.scrape_failures,
+                "freshness_s": round(self.collector.freshness_s(), 3),
+            }
         return out
 
     def readyz(self) -> dict:
@@ -802,5 +859,17 @@ class RouterServer(JsonHTTPFront):
                 if not name.startswith(("span:", "span_device:"))
             },
             "fleet": self.router.healthz(),
+            # The merged fleet view (docs/OBSERVABILITY.md §14): counters
+            # summed exactly across replicas (terminal scrapes included,
+            # so a drained member's tally survives it), histograms as
+            # merged sketches, gauges labelled per replica — plus the
+            # per-replica scrape ledger.
+            "fleet_telemetry": (
+                None if self.collector is None else {
+                    "aggregate": self.collector.aggregate(),
+                    "replicas": self.collector.per_replica(),
+                }
+            ),
+            "slo": None if self.slo is None else self.slo.status(),
             "config": exec_config.effective_config(),
         }

@@ -105,6 +105,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(200 if payload.get("ready") else 503, payload)
             elif self.path == "/varz":
                 self._reply(200, self.server.varz())
+            elif self.path == "/telemetryz":
+                self._reply(200, self.server.telemetryz())
             else:
                 self._reply(404, {"error": f"unknown path {self.path!r}"})
         except Exception as e:  # never let a probe kill the connection
@@ -274,6 +276,19 @@ class JsonHTTPFront(ThreadingHTTPServer):
         self.stop()
 
     # ----------------------------------------------------------- probes -----
+    def telemetryz(self) -> dict:
+        """The mergeable telemetry scrape (docs/OBSERVABILITY.md §14): the
+        process-global registry in :meth:`~..telemetry.registry.Registry.
+        mergeable_snapshot` wire form, stamped with this process's
+        identity. Both front ends expose it — a replica's scrape feeds
+        the fleet collector; the router's is its own local view."""
+        from ..telemetry.aggregate import process_identity
+
+        snap = REGISTRY.mergeable_snapshot()
+        if not snap.get("identity"):
+            snap["identity"] = process_identity()
+        return snap
+
     def livez(self) -> dict:
         """Liveness: answering at all is the signal; the body is detail."""
         return {
@@ -446,11 +461,24 @@ class ServingServer(JsonHTTPFront):
                     raise
                 continue
             break
+        from ..telemetry.aggregate import process_identity
+
         out = {
             "version": result.version,
             "trace_id": result.trace_id,
             "queue_wait_ms": round(result.queue_wait_s * 1e3, 3),
             "dispatch_ms": round(result.dispatch_s * 1e3, 3),
+            # Structured latency attribution (docs/OBSERVABILITY.md §14):
+            # the same legs as the top-level ms fields (kept for compat)
+            # plus the coalescing context, and the identity of the
+            # process that actually served — clients can attribute
+            # latency without a telemetry capture.
+            "server_timing": {
+                "queue_wait_ms": round(result.queue_wait_s * 1e3, 3),
+                "dispatch_ms": round(result.dispatch_s * 1e3, 3),
+                "rows_coalesced": result.rows_coalesced,
+            },
+            "server": process_identity(),
         }
         if tenant is not None:
             out["tenant"] = tenant

@@ -43,6 +43,12 @@ NOT initialize jax — device-touching helpers import it lazily.
 
 from __future__ import annotations
 
+from .aggregate import (
+    FleetCollector,
+    install_process_identity,
+    merge_snapshots,
+    process_identity,
+)
 from .export import (
     SINK_ENV,
     configure_sinks_from_env,
@@ -52,22 +58,30 @@ from .export import (
 from .flightrec import FLIGHT_ENV
 from .gauges import install_jax_hooks, sample_device_gauges
 from .registry import REGISTRY, Histogram, Registry
+from .slo import Objective, SloEvaluator, default_objectives
 from .spans import FENCE_ENV, Span, current_span, span
 from .tracing import current_trace_id, new_trace_id, trace_request
 
 __all__ = [
     "FENCE_ENV",
     "FLIGHT_ENV",
+    "FleetCollector",
     "Histogram",
+    "Objective",
     "REGISTRY",
     "Registry",
     "SINK_ENV",
+    "SloEvaluator",
     "Span",
     "configure_sinks_from_env",
     "current_span",
     "current_trace_id",
+    "default_objectives",
     "install_jax_hooks",
+    "install_process_identity",
+    "merge_snapshots",
     "new_trace_id",
+    "process_identity",
     "render_prometheus",
     "sample_device_gauges",
     "span",

@@ -1,0 +1,369 @@
+"""Fleet-wide telemetry aggregation over per-process registries.
+
+PR 15 made replicas real subprocesses, which fractured the registry: each
+replica owns a process-local :data:`~.registry.REGISTRY`, so the
+coordinator's counters describe only the coordinator. This module is the
+merge/reduce half of the observability plane (the aggregation shape is
+DrJAX's reduce-over-workers, arXiv:2403.07128, applied to metrics):
+
+  * :func:`install_process_identity` stamps who-is-recording (replica
+    name, pid, accelerator platform) into a registry, from where it rides
+    every exported span event, every HTTP response, and the
+    ``/telemetryz`` wire form.
+  * :func:`merge_snapshots` folds any number of
+    :meth:`~.registry.Registry.mergeable_snapshot` dicts into one view —
+    counters summed exactly, histogram sketches merged via
+    :meth:`~.registry.Histogram.merge` (count/sum/min/max exact,
+    percentiles reservoir-approximate), gauges relabelled per replica.
+  * :class:`FleetCollector` rides the coordinator's probe/supervision
+    loop: it records each replica's ``/telemetryz`` scrape, folds a
+    member's **terminal** scrape into a retained per-name base when the
+    member drains away or its pid changes (a scale-down or supervised
+    restart no longer loses telemetry — counters stay monotone across
+    replica generations), and serves the fleet aggregate plus per-replica
+    views for the router's ``/varz``.
+
+The collector's own health is itself telemetry: every recorded scrape
+counts ``fleet/agg_scrapes`` and every failed one
+``fleet/agg_scrape_failures`` (zero-baseline regression-guarded in
+:mod:`.compare`), and :meth:`FleetCollector.freshness_s` publishes the
+age of the stalest live member's scrape as
+``langdetect_fleet_scrape_age_s`` — the SLO layer's freshness input.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable
+
+from .registry import REGISTRY, Histogram, Registry
+
+# Wire-form version of Registry.mergeable_snapshot — bumped only on an
+# incompatible shape change; the collector refuses mismatched scrapes
+# (counted as scrape failures) instead of merging garbage.
+SNAPSHOT_SCHEMA = 1
+
+# --- contract tables (harvested by analysis/, rule R2) ----------------------
+# Counter names the aggregation plane READS from the merged stream: the
+# autoscaler's fleet-aggregate shed pressure (scale/elastic) sums the
+# replica-side and router-side shed odometers out of the collector. Each
+# name must exist at a real emit site — a renamed counter would silently
+# zero the autoscaler's pressure signal, so the static contract checker
+# fails tier-1 instead.
+CONSUMED_COUNTERS = (
+    "serve/shed_requests",
+    "fleet/shed_requests",
+)
+# Counters the collector itself emits about the scrape loop. The checker
+# additionally pins these into telemetry/compare's tracked tables: a
+# scrape failure appearing against a clean baseline must regress.
+GUARD_COUNTERS = (
+    "fleet/agg_scrapes",
+    "fleet/agg_scrape_failures",
+)
+
+
+def process_identity(registry: Registry | None = None) -> dict:
+    """This process's identity block (replica/pid/platform when installed
+    via :func:`install_process_identity`; a bare pid otherwise). Stamped
+    into HTTP responses so multi-process captures are attributable."""
+    reg = REGISTRY if registry is None else registry
+    if reg.identity:
+        return dict(reg.identity)
+    return {"pid": os.getpid()}
+
+
+def install_process_identity(
+    registry: Registry | None = None,
+    *,
+    replica: str,
+    pid: int | None = None,
+    platform: str | None = None,
+) -> dict:
+    """Stamp (replica, pid, platform) into ``registry.identity``.
+
+    Called once by the replica worker after its jax platform pin;
+    ``platform=None`` resolves ``jax.default_backend()`` lazily (and
+    degrades to unknown when jax is absent — identity must never take
+    down a worker)."""
+    reg = REGISTRY if registry is None else registry
+    if platform is None:
+        try:
+            import jax
+
+            platform = jax.default_backend()
+        except Exception:
+            platform = "unknown"
+    reg.identity.update(
+        replica=str(replica),
+        pid=int(os.getpid() if pid is None else pid),
+        platform=str(platform),
+    )
+    return dict(reg.identity)
+
+
+# ----------------------------------------------------------- pure merging ---
+def _label_str(labels: dict, extra: dict | None = None) -> str:
+    merged = dict(labels)
+    for k, v in (extra or {}).items():
+        merged.setdefault(k, v)
+    return ",".join(f"{k}={v}" for k, v in sorted(merged.items()))
+
+
+def merge_snapshots(snaps: list[tuple[str, dict]]) -> dict:
+    """Fold ``(member name, mergeable_snapshot)`` pairs into one view.
+
+    Counters sum exactly. Histograms merge into one sketch per name
+    (count/sum/min/max exact; percentiles reservoir-approximate — the
+    same fidelity each process had locally). Gauges are NOT summed (the
+    last value of ``langdetect_serve_queue_rows`` on r0 plus r1 means
+    nothing): each series keeps its value under its member's ``replica``
+    label, so per-replica detail survives the merge."""
+    counters: dict[str, float] = {}
+    hists: dict[str, Histogram] = {}
+    gauges: dict[str, dict[str, float]] = {}
+    for name, snap in snaps:
+        for cname, val in (snap.get("counters") or {}).items():
+            if isinstance(val, (int, float)):
+                counters[cname] = counters.get(cname, 0) + val
+        for hname, state in (snap.get("histograms") or {}).items():
+            if isinstance(state, dict):
+                hists.setdefault(hname, Histogram()).merge(state)
+        ident = snap.get("identity") or {}
+        extra = {"replica": ident.get("replica", name)}
+        for gname, series in (snap.get("gauges") or {}).items():
+            out = gauges.setdefault(gname, {})
+            for pair in series or ():
+                try:
+                    labels, val = pair
+                except (TypeError, ValueError):
+                    continue
+                if isinstance(val, (int, float)) and isinstance(labels, dict):
+                    out[_label_str(labels, extra)] = float(val)
+    return {"counters": counters, "histograms": hists, "gauges": gauges}
+
+
+class FleetCollector:
+    """Scrape accumulator with terminal-scrape retention.
+
+    One collector per coordinator. The coordinator's own registry is an
+    implicit member (``local_name``) read live at aggregation time — the
+    router-side counters (``fleet/shed_requests``, probe rounds) belong
+    in the fleet view too. Replica members are fed via :meth:`scrape`
+    (or :meth:`record` when the caller already holds a snapshot);
+    :meth:`retire` folds a member's last scrape into a retained per-name
+    base, and a pid change between scrapes folds the dead generation
+    automatically — so :meth:`aggregate` counters are monotone across
+    scale-downs, crashes, and supervised restarts.
+    """
+
+    def __init__(
+        self,
+        *,
+        registry: Registry | None = None,
+        local_name: str = "router",
+    ):
+        self.registry = REGISTRY if registry is None else registry
+        self.local_name = local_name
+        self._lock = threading.Lock()
+        # name -> {"snap": mergeable snapshot, "pid": int|None, "at": mono}
+        self._live: dict[str, dict] = {}
+        # name -> {"counters": {...}, "histograms": {name: Histogram},
+        #          "identity": {...}, "generations": int}
+        self._retained: dict[str, dict] = {}
+        self.scrapes = 0
+        self.scrape_failures = 0
+
+    # ------------------------------------------------------------ feeding ---
+    def scrape(self, name: str, fetch: Callable[[], dict]) -> bool:
+        """Fetch one member's ``/telemetryz`` (any raising callable) and
+        record it. Failures are contained and counted — a mid-death
+        member must not take down the probe loop riding this."""
+        try:
+            snap = fetch()
+            if not isinstance(snap, dict) or (
+                snap.get("schema") != SNAPSHOT_SCHEMA
+            ):
+                raise ValueError(
+                    f"bad /telemetryz schema from {name!r}: "
+                    f"{snap.get('schema') if isinstance(snap, dict) else snap!r}"
+                )
+        except Exception:
+            self.note_failure(name)
+            return False
+        self.record(name, snap)
+        return True
+
+    def record(self, name: str, snap: dict) -> None:
+        """Accept one scraped snapshot. A pid change against the previous
+        scrape means the member restarted: the dead generation's last
+        scrape folds into the retained base first, so its counters are
+        never lost and never double-counted."""
+        pid = (snap.get("identity") or {}).get("pid")
+        with self._lock:
+            prev = self._live.get(name)
+            if (
+                prev is not None
+                and prev.get("pid") is not None
+                and pid != prev.get("pid")
+            ):
+                self._fold_locked(name, prev["snap"])
+            self._live[name] = {
+                "snap": snap, "pid": pid, "at": time.monotonic(),
+            }
+            self.scrapes += 1
+        self.registry.incr("fleet/agg_scrapes")
+
+    def note_failure(self, name: str) -> None:
+        with self._lock:
+            self.scrape_failures += 1
+        self.registry.incr("fleet/agg_scrape_failures")
+
+    def retire(self, name: str) -> None:
+        """Terminal retention: fold the member's last scrape into the
+        per-name base (scale-down / gave-up). Idempotent; a name with no
+        scrape history is a no-op."""
+        with self._lock:
+            entry = self._live.pop(name, None)
+            if entry is not None:
+                self._fold_locked(name, entry["snap"])
+
+    def _fold_locked(self, name: str, snap: dict) -> None:
+        base = self._retained.setdefault(
+            name,
+            {"counters": {}, "histograms": {}, "identity": {},
+             "generations": 0},
+        )
+        for cname, val in (snap.get("counters") or {}).items():
+            if isinstance(val, (int, float)):
+                base["counters"][cname] = (
+                    base["counters"].get(cname, 0) + val
+                )
+        for hname, state in (snap.get("histograms") or {}).items():
+            if isinstance(state, dict):
+                base["histograms"].setdefault(
+                    hname, Histogram()
+                ).merge(state)
+        base["identity"] = dict(snap.get("identity") or {})
+        base["generations"] += 1
+
+    # ----------------------------------------------------------- reading ----
+    def _member_snaps_locked(self) -> list[tuple[str, dict]]:
+        out: list[tuple[str, dict]] = []
+        for name, base in self._retained.items():
+            out.append((name, {
+                "counters": dict(base["counters"]),
+                "histograms": {
+                    h: hist.state()
+                    for h, hist in base["histograms"].items()
+                },
+                "gauges": {},  # a gone generation's gauges are stale truth
+                "identity": dict(base["identity"]),
+            }))
+        for name, entry in self._live.items():
+            out.append((name, entry["snap"]))
+        return out
+
+    def aggregate(self) -> dict:
+        """The fleet-wide merged view: live members + retained terminal
+        scrapes + the coordinator's own registry, via
+        :func:`merge_snapshots`. Histograms come back as display
+        snapshots (count/sum/min/max/percentiles)."""
+        with self._lock:
+            snaps = self._member_snaps_locked()
+        snaps.append((self.local_name, self.registry.mergeable_snapshot()))
+        merged = merge_snapshots(snaps)
+        merged["histograms"] = {
+            name: h.snapshot() for name, h in merged["histograms"].items()
+        }
+        merged["members"] = self.members()
+        merged["scrapes"] = self.scrapes
+        merged["scrape_failures"] = self.scrape_failures
+        return merged
+
+    def counter(self, name: str, *, include_local: bool = True) -> float:
+        """One aggregate counter, cheaply: retained base + each live
+        member's last scrape + (optionally) the coordinator's live value.
+        Monotone by construction — the autoscaler differentiates it
+        without per-member clamping."""
+        total = 0.0
+        with self._lock:
+            for base in self._retained.values():
+                total += base["counters"].get(name, 0)
+            for entry in self._live.values():
+                val = (entry["snap"].get("counters") or {}).get(name, 0)
+                if isinstance(val, (int, float)):
+                    total += val
+        if include_local:
+            total += self.registry.counters.get(name, 0)
+        return total
+
+    def per_replica(self) -> dict[str, dict]:
+        """Per-member condensed views (identity, state, counters) — the
+        fleet ``/varz`` drill-down. Retained (drained/dead) members keep
+        their folded counters under ``state: "retired"``."""
+        out: dict[str, dict] = {}
+        with self._lock:
+            for name, base in self._retained.items():
+                out[name] = {
+                    "state": "retired",
+                    "identity": dict(base["identity"]),
+                    "generations": base["generations"],
+                    "counters": dict(base["counters"]),
+                }
+            for name, entry in self._live.items():
+                snap = entry["snap"]
+                prev = out.pop(name, None)
+                counters = dict(snap.get("counters") or {})
+                generations = 1
+                if prev is not None:
+                    # A restarted member: live generation rides on top of
+                    # its folded predecessors, same as aggregate().
+                    for cname, val in prev["counters"].items():
+                        counters[cname] = counters.get(cname, 0) + val
+                    generations += prev["generations"]
+                out[name] = {
+                    "state": "live",
+                    "identity": dict(snap.get("identity") or {}),
+                    "generations": generations,
+                    "counters": counters,
+                    "scrape_ts": snap.get("ts"),
+                }
+        return out
+
+    def members(self) -> dict[str, dict]:
+        """Identity/state roster without the counter payloads."""
+        now = time.monotonic()
+        out: dict[str, dict] = {}
+        with self._lock:
+            for name, base in self._retained.items():
+                out[name] = {
+                    "state": "retired",
+                    "identity": dict(base["identity"]),
+                    "generations": base["generations"],
+                }
+            for name, entry in self._live.items():
+                info = out.get(name) or {"generations": 0}
+                out[name] = {
+                    "state": "live",
+                    "identity": dict(
+                        (entry["snap"].get("identity") or {})
+                    ),
+                    "generations": info.get("generations", 0) + 1,
+                    "age_s": round(now - entry["at"], 3),
+                }
+        return out
+
+    def freshness_s(self) -> float:
+        """Age of the stalest live member's scrape (0.0 with no live
+        members — an empty fleet is vacuously fresh), published as the
+        ``langdetect_fleet_scrape_age_s`` gauge: the SLO layer's
+        guard-freshness input."""
+        now = time.monotonic()
+        with self._lock:
+            ages = [now - entry["at"] for entry in self._live.values()]
+        age = max(ages) if ages else 0.0
+        self.registry.set_gauge("langdetect_fleet_scrape_age_s", age)
+        return age

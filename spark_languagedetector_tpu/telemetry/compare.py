@@ -98,6 +98,11 @@ _RELIABILITY_COUNTERS = (
     # Scale-ups/downs are the autoscaler doing its job (informational).
     "scale/spawn_failures",
     "scale/restarts",
+    # Fleet observability plane (docs/OBSERVABILITY.md §14): a telemetry
+    # scrape failing against a clean baseline means the coordinator is
+    # flying partially blind — the aggregate (and everything reading it:
+    # autoscaler pressure, SLO burn rates) silently under-counts.
+    "fleet/agg_scrape_failures",
 )
 
 # Informational counters: diffed and shown like the reliability set but
@@ -114,6 +119,12 @@ _INFORMATIONAL_COUNTERS = (
     "scale/ups",
     "scale/downs",
     "scale/orphans_reaped",
+    # Observability-plane volume: scrape rounds happening and SLO alert
+    # transitions firing are the plane working (the alert may be the
+    # CORRECT response to induced load) — the regression gates live on
+    # fleet/agg_scrape_failures and the slo/burn_rate histogram instead.
+    "fleet/agg_scrapes",
+    "slo/alerts",
 )
 
 _TRACKED_RATIOS = {

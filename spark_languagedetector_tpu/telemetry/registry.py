@@ -72,6 +72,83 @@ class Histogram:
     def mean(self) -> float:
         return self.total / self.count if self.count else math.nan
 
+    # ------------------------------------------------------------ merging ----
+    def state(self) -> dict:
+        """JSON-safe mergeable form: exact count/sum/min/max + the raw
+        reservoir samples. This is what ``/telemetryz`` puts on the wire —
+        :meth:`merge` on the far side reconstitutes a fleet-wide sketch
+        (count/sum/min/max stay exact; percentiles are reservoir-
+        approximate, same as locally)."""
+        out: dict = {
+            "count": self.count,
+            "sum": self.total,
+            "reservoir": list(self._res),
+        }
+        if self.count:
+            out["min"] = self.min
+            out["max"] = self.max
+        return out
+
+    @classmethod
+    def from_state(cls, state: dict, reservoir_size: int = DEFAULT_RESERVOIR):
+        h = cls(reservoir_size)
+        h.merge(state)
+        return h
+
+    @staticmethod
+    def _thin(samples: list[float], keep: int) -> list[float]:
+        # Deterministic uniform thinning (evenly spaced picks over the
+        # sample order): two merges of the same scrapes yield the same
+        # reservoir, so fleet-aggregate percentiles stay diffable run to
+        # run — the same property the per-process LCG reservoir has.
+        if keep >= len(samples):
+            return list(samples)
+        if keep <= 0:
+            return []
+        step = len(samples) / keep
+        return [samples[int(i * step)] for i in range(keep)]
+
+    def merge(self, other: "Histogram | dict") -> "Histogram":
+        """Fold another histogram (or its :meth:`state` dict) into this
+        one. Count/sum/min/max merge exactly; the reservoirs merge by
+        population-weighted deterministic thinning, so the combined
+        reservoir approximates a uniform sample over BOTH populations.
+        Returns self (chainable folds in the fleet collector)."""
+        state = other.state() if isinstance(other, Histogram) else other
+        count = int(state.get("count", 0) or 0)
+        if count <= 0:
+            return self
+        prior = self.count
+        self.count += count
+        self.total += float(state.get("sum", 0.0) or 0.0)
+        mn, mx = state.get("min"), state.get("max")
+        if isinstance(mn, (int, float)) and mn < self.min:
+            self.min = float(mn)
+        if isinstance(mx, (int, float)) and mx > self.max:
+            self.max = float(mx)
+        incoming = [
+            float(v) for v in (state.get("reservoir") or ())
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        ]
+        if not incoming:
+            return self
+        if len(self._res) + len(incoming) <= self._cap:
+            self._res.extend(incoming)
+            return self
+        # Over capacity: each side keeps slots proportional to the
+        # population it represents (not its reservoir length), clamped so
+        # a tiny-but-present side is never thinned to nothing.
+        keep_inc = round(self._cap * count / self.count)
+        keep_inc = min(len(incoming), max(1, keep_inc))
+        keep_own = min(len(self._res), self._cap - keep_inc)
+        if prior > 0:
+            keep_own = max(1, keep_own)
+            keep_inc = min(keep_inc, self._cap - keep_own)
+        self._res = (
+            self._thin(self._res, keep_own) + self._thin(incoming, keep_inc)
+        )
+        return self
+
     def snapshot(self) -> dict:
         out = {
             "count": self.count,
@@ -103,6 +180,12 @@ class Registry:
         # gauge name -> {sorted (label, value) tuple -> last value}
         self.gauges: dict[str, dict[tuple[tuple[str, str], ...], float]] = {}
         self._sinks: list[Any] = []
+        # Process identity (replica name, pid, platform), installed once
+        # by replica workers (telemetry.aggregate.install_process_identity)
+        # and stamped onto every exported span event + the mergeable
+        # snapshot — multi-process captures stay attributable without
+        # out-of-band context.
+        self.identity: dict[str, Any] = {}
 
     # ------------------------------------------------------------- sinks ----
     def add_sink(self, sink) -> None:
@@ -200,6 +283,11 @@ class Registry:
             event["device_s"] = device_s
         if attrs:
             event.update(attrs)
+        # Identity labels never override a span's own attrs of the same
+        # name (a router span naming the replica it dispatched TO keeps
+        # that name; the stamp says who recorded).
+        for k, v in self.identity.items():
+            event.setdefault(k, v)
         self.emit(event)
 
     # --------------------------------------------------------- snapshots ----
@@ -213,6 +301,28 @@ class Registry:
                 "gauges": {
                     name: {",".join(f"{k}={v}" for k, v in key) or "": val
                            for key, val in series.items()}
+                    for name, series in self.gauges.items()
+                },
+            }
+
+    def mergeable_snapshot(self) -> dict:
+        """The ``/telemetryz`` wire form: everything :meth:`snapshot`
+        carries, but in a shape a fleet collector can MERGE instead of
+        merely display — exact counters, histograms as
+        :meth:`Histogram.state` sketches (count/sum/min/max exact,
+        reservoir for percentiles), gauges with structured label pairs,
+        and the recording process's identity block."""
+        with self._lock:
+            return {
+                "schema": 1,
+                "ts": time.time(),
+                "identity": dict(self.identity),
+                "counters": dict(self.counters),
+                "histograms": {
+                    name: h.state() for name, h in self.histograms.items()
+                },
+                "gauges": {
+                    name: [[dict(key), val] for key, val in series.items()]
                     for name, series in self.gauges.items()
                 },
             }

@@ -1,0 +1,513 @@
+"""Fleet observability plane: mergeable scrapes, stitching, SLO gates (ISSUE 16).
+
+The acceptance contract (docs/OBSERVABILITY.md §14–15): histogram merges
+keep count/sum/min/max exact and reservoirs deterministic; the
+``/telemetryz`` wire form round-trips through :func:`merge_snapshots`
+with counters summed exactly and gauges relabelled per replica; the
+collector's aggregate is monotone across supervised restarts, terminal
+(retire) scrapes, and scrape failures — which are counted, never
+propagated, including under the ``fleet/scrape`` fault site; the SLO
+evaluator's multi-window burn-rate alert trips and clears
+deterministically under explicit clocks; cross-process captures stitch
+onto the coordinator's clock with request flows joined by ``trace_id``
+and non-negative nesting slack; and the trimmed ``--smoke-obs`` bench
+gate holds end to end over a real 2-replica subprocess fleet.
+"""
+
+import json
+
+import pytest
+
+from spark_languagedetector_tpu.resilience import faults
+from spark_languagedetector_tpu.resilience.faults import FaultPlan
+from spark_languagedetector_tpu.telemetry import stitch
+from spark_languagedetector_tpu.telemetry.aggregate import (
+    SNAPSHOT_SCHEMA,
+    FleetCollector,
+    install_process_identity,
+    merge_snapshots,
+    process_identity,
+)
+from spark_languagedetector_tpu.telemetry.registry import Histogram, Registry
+from spark_languagedetector_tpu.telemetry.slo import (
+    SloEvaluator,
+    default_objectives,
+)
+
+
+# ------------------------------------------------------ histogram merging ---
+def test_histogram_merge_exact_moments():
+    """Count/sum/min/max of a merge equal recording everything into one
+    histogram — the exact half of the sketch is exact, full stop."""
+    left, right, oracle = Histogram(), Histogram(), Histogram()
+    for i in range(700):
+        v = (i * 37 % 101) / 7.0
+        (left if i % 2 else right).record(v)
+        oracle.record(v)
+    merged = Histogram().merge(left).merge(right)
+    assert merged.count == oracle.count == 700
+    assert merged.total == pytest.approx(oracle.total, abs=1e-9)
+    assert merged.min == oracle.min
+    assert merged.max == oracle.max
+
+
+def test_histogram_merge_deterministic_reservoir():
+    """Two merges of the same scrape states produce byte-identical
+    reservoirs (and hence identical percentiles) even past capacity —
+    fleet-aggregate percentiles stay diffable run to run."""
+    a, b = Histogram(), Histogram()
+    for i in range(900):
+        a.record(float(i))
+        b.record(float(i) + 0.5)
+    sa, sb = a.state(), b.state()
+
+    def build():
+        return Histogram().merge(sa).merge(sb)
+
+    one, two = build(), build()
+    assert one._res == two._res
+    assert len(one._res) <= 512
+    for p in (50, 90, 99):
+        assert one.percentile(p) == two.percentile(p)
+    # Both populations survive the proportional thinning.
+    assert any(v == int(v) for v in one._res)
+    assert any(v != int(v) for v in one._res)
+
+
+def test_histogram_state_roundtrip_and_empty_merge():
+    h = Histogram()
+    for v in (1.0, 2.0, 3.0):
+        h.record(v)
+    back = Histogram.from_state(json.loads(json.dumps(h.state())))
+    assert (back.count, back.total, back.min, back.max) == (3, 6.0, 1.0, 3.0)
+    assert back.percentile(50) == 2.0
+    # Merging an empty state is a no-op, not a corruption.
+    before = back.state()
+    back.merge(Histogram().state())
+    assert back.state() == before
+
+
+# ------------------------------------------------- mergeable wire form ------
+def _registry_with(replica, counters, hist_values, gauges=()):
+    reg = Registry()
+    install_process_identity(reg, replica=replica, pid=1000, platform="cpu")
+    for name, n in counters.items():
+        reg.incr(name, n)
+    for v in hist_values:
+        reg.observe("fleet/request_s", v)
+    for name, val, labels in gauges:
+        reg.set_gauge(name, val, **labels)
+    return reg
+
+
+def test_mergeable_snapshot_roundtrip_through_merge():
+    r0 = _registry_with(
+        "r0", {"serve/requests": 5, "serve/shed_requests": 1}, [0.1, 0.2],
+        gauges=[("langdetect_serve_queue_rows", 7.0, {})],
+    )
+    r1 = _registry_with(
+        "r1", {"serve/requests": 8}, [0.3],
+        gauges=[("langdetect_serve_queue_rows", 3.0, {})],
+    )
+    snaps = [
+        ("r0", json.loads(json.dumps(r0.mergeable_snapshot()))),
+        ("r1", json.loads(json.dumps(r1.mergeable_snapshot()))),
+    ]
+    for _, snap in snaps:
+        assert snap["schema"] == SNAPSHOT_SCHEMA
+        assert set(snap["identity"]) == {"replica", "pid", "platform"}
+    merged = merge_snapshots(snaps)
+    assert merged["counters"]["serve/requests"] == 13
+    assert merged["counters"]["serve/shed_requests"] == 1
+    hist = merged["histograms"]["fleet/request_s"]
+    assert hist.count == 3
+    assert hist.total == pytest.approx(0.6)
+    assert (hist.min, hist.max) == (0.1, 0.3)
+    # Gauges are labelled per replica, never summed.
+    series = merged["gauges"]["langdetect_serve_queue_rows"]
+    assert series == {"replica=r0": 7.0, "replica=r1": 3.0}
+
+
+def test_process_identity_fallback():
+    reg = Registry()
+    assert set(process_identity(reg)) == {"pid"}
+    install_process_identity(reg, replica="rX", pid=42, platform="cpu")
+    assert process_identity(reg) == {
+        "replica": "rX", "pid": 42, "platform": "cpu",
+    }
+
+
+# ---------------------------------------------------------- the collector ---
+def _snap(replica, pid, counters, hist_values=()):
+    reg = Registry()
+    install_process_identity(reg, replica=replica, pid=pid, platform="cpu")
+    for name, n in counters.items():
+        reg.incr(name, n)
+    for v in hist_values:
+        reg.observe("fleet/request_s", v)
+    return reg.mergeable_snapshot()
+
+
+def test_collector_monotone_across_restart_and_retire():
+    """The aggregate counter never decreases: a pid change folds the dead
+    generation, retire() retains the terminal scrape, and per-replica
+    views ride the same bases."""
+    local = Registry()
+    col = FleetCollector(registry=local, local_name="router")
+    assert col.scrape("r0", lambda: _snap("r0", 1, {"serve/requests": 5}))
+    assert col.counter("serve/requests") == 5
+    # Supervised restart: the replica's odometer resets, the aggregate
+    # must not (generation folding).
+    assert col.scrape("r0", lambda: _snap("r0", 2, {"serve/requests": 3}))
+    assert col.counter("serve/requests") == 8
+    view = col.per_replica()["r0"]
+    assert view["state"] == "live" and view["generations"] == 2
+    assert view["counters"]["serve/requests"] == 8
+    # Terminal retention: the drained member's counters survive.
+    col.retire("r0")
+    assert col.counter("serve/requests") == 8
+    view = col.per_replica()["r0"]
+    assert view["state"] == "retired" and view["generations"] == 2
+    agg = col.aggregate()
+    assert agg["counters"]["serve/requests"] == 8
+    assert agg["members"]["r0"]["state"] == "retired"
+    # retire is idempotent; a never-scraped name is a no-op.
+    col.retire("r0")
+    col.retire("ghost")
+    assert col.counter("serve/requests") == 8
+
+
+def test_collector_aggregate_includes_local_and_merges_histograms():
+    local = Registry()
+    local.incr("fleet/shed_requests", 2)
+    col = FleetCollector(registry=local, local_name="router")
+    col.record("r0", _snap("r0", 1, {"serve/requests": 4}, [0.25, 0.75]))
+    col.record("r1", _snap("r1", 2, {"serve/requests": 6}, [0.5]))
+    assert col.counter("serve/requests") == 10
+    assert col.counter("fleet/shed_requests") == 2
+    assert col.counter("fleet/shed_requests", include_local=False) == 0
+    agg = col.aggregate()
+    hist = agg["histograms"]["fleet/request_s"]
+    assert hist["count"] == 3 and hist["sum"] == pytest.approx(1.5)
+    # The collector's own scrape odometer rides the local registry.
+    assert local.counters["fleet/agg_scrapes"] == 2
+
+
+def test_collector_scrape_failures_counted_never_raised():
+    local = Registry()
+    col = FleetCollector(registry=local, local_name="router")
+
+    def boom():
+        raise ConnectionError("mid-death member")
+
+    assert col.scrape("r0", boom) is False
+    # A wrong wire schema is a failure too — never merged as garbage.
+    assert col.scrape("r0", lambda: {"schema": 99}) is False
+    assert col.scrape_failures == 2
+    assert local.counters["fleet/agg_scrape_failures"] == 2
+    assert "r0" not in col.per_replica()
+
+
+def test_fleet_scrape_fault_site_contained():
+    """An injected ``fleet/scrape`` error is counted like a real scrape
+    miss and contained by the collector — the elastic tick loop's call
+    pattern (fetch wraps the inject) never sees the raise."""
+    local = Registry()
+    col = FleetCollector(registry=local, local_name="router")
+    good = _snap("r0", 1, {"serve/requests": 5})
+
+    def fetch():
+        faults.inject("fleet/scrape")
+        return good
+
+    with faults.plan_scope(FaultPlan.parse("fleet/scrape:error@2")):
+        assert col.scrape("r0", fetch) is True
+        assert col.scrape("r0", fetch) is False  # call 2 fires
+        assert col.scrape("r0", fetch) is True
+    assert col.scrape_failures == 1
+    assert local.counters["fleet/agg_scrape_failures"] == 1
+    # The retained data is the last GOOD scrape; the aggregate survived.
+    assert col.counter("serve/requests") == 5
+
+
+def test_collector_freshness_gauge():
+    local = Registry()
+    col = FleetCollector(registry=local, local_name="router")
+    assert col.freshness_s() == 0.0  # empty fleet is vacuously fresh
+    col.record("r0", _snap("r0", 1, {}))
+    age = col.freshness_s()
+    assert 0.0 <= age < 5.0
+    series = local.snapshot()["gauges"]["langdetect_fleet_scrape_age_s"]
+    assert series[""] == age
+
+
+# ------------------------------------------------------------- SLO gates ----
+def _agg(requests, sheds, *, p99=None, age=None):
+    out = {
+        "counters": {
+            "fleet/requests": requests,
+            "fleet/shed_requests": sheds,
+            "serve/shed_requests": 0,
+        },
+        "histograms": {}, "gauges": {},
+    }
+    if p99 is not None:
+        # The sketch is cumulative: count tracks total completions so the
+        # evaluator's new-traffic gate sees a positive delta per ingest.
+        out["histograms"]["fleet/request_s"] = {"count": requests, "p99": p99}
+    if age is not None:
+        out["gauges"]["langdetect_fleet_scrape_age_s"] = {"": age}
+    return out
+
+
+def test_slo_availability_trip_and_clear_deterministic():
+    """The multi-window latch under an explicit clock: trips only when
+    BOTH windows burn, holds while the short window burns, clears when
+    the short window drains — and ``slo/alerts`` counts the rising edge
+    exactly once."""
+    reg = Registry()
+    ev = SloEvaluator(
+        default_objectives(), registry=reg,
+        short_window_s=10.0, long_window_s=30.0,
+    )
+    st = ev.ingest(_agg(100, 0), now=0.0)
+    assert not st["burning"]
+    # Shed burst: 50 sheds against 100 new requests — burn >> 1 on both
+    # windows, the alert fires.
+    st = ev.ingest(_agg(200, 50), now=1.0)
+    assert st["burning"] and st["reasons"] == ["slo_availability_burn"]
+    assert reg.counters["slo/alerts"] == 1
+    avail = st["objectives"]["availability"]
+    assert avail["alerting"]
+    assert avail["burn_short"] >= 1.0 and avail["burn_long"] >= 1.0
+    # Still inside the short window: the latch holds, no second alert.
+    st = ev.ingest(_agg(300, 50), now=2.0)
+    assert st["burning"]
+    assert reg.counters["slo/alerts"] == 1
+    # The bad sample ages out of the short window: clean traffic clears.
+    st = ev.ingest(_agg(400, 50), now=20.0)
+    assert not st["burning"] and not ev.burning()
+    assert st["objectives"]["availability"]["burn_short"] == 0.0
+    assert reg.counters["slo/alerts"] == 1
+    # Worst burn rode the upward-regressing histogram every evaluation.
+    assert reg.histograms["slo/burn_rate"].count == 4
+    series = reg.snapshot()["gauges"]["langdetect_slo_burn_rate"]
+    assert series["objective=availability"] == 0.0
+
+
+def test_slo_counter_reset_clamped():
+    reg = Registry()
+    ev = SloEvaluator(
+        default_objectives(), registry=reg,
+        short_window_s=10.0, long_window_s=30.0,
+    )
+    ev.ingest(_agg(100, 0), now=0.0)
+    # A collector reset (counters drop) must read as fresh traffic, not
+    # negative deltas.
+    st = ev.ingest(_agg(40, 0), now=1.0)
+    assert not st["burning"]
+    assert st["objectives"]["availability"]["burn_short"] == 0.0
+
+
+def test_slo_latency_and_freshness_objectives():
+    reg = Registry()
+    ev = SloEvaluator(
+        default_objectives(latency_p99_ms=250.0, freshness_s=10.0),
+        registry=reg, short_window_s=10.0, long_window_s=30.0,
+    )
+    st = ev.ingest(_agg(10, 0, p99=0.1, age=1.0), now=0.0)
+    assert not st["burning"]
+    st = ev.ingest(_agg(20, 0, p99=0.9, age=99.0), now=1.0)
+    assert st["burning"]
+    assert set(st["reasons"]) == {
+        "slo_latency_p99_burn", "slo_freshness_burn",
+    }
+    # Recovered p99/age past the short window: both clear.
+    st = ev.ingest(_agg(30, 0, p99=0.1, age=1.0), now=20.0)
+    assert not st["burning"]
+
+
+def test_slo_latency_alert_clears_in_silence():
+    """The merged sketch is cumulative, so its p99 never forgets a slow
+    burst — the latency objective must only record verdicts over NEW
+    completions, or one burst latches the alert (and the autoscaler's
+    pressure input) forever."""
+    reg = Registry()
+    ev = SloEvaluator(
+        default_objectives(latency_p99_ms=250.0), registry=reg,
+        short_window_s=10.0, long_window_s=30.0,
+    )
+    ev.ingest(_agg(10, 0, p99=0.9), now=0.0)
+    st = ev.ingest(_agg(20, 0, p99=0.9), now=1.0)
+    assert st["reasons"] == ["slo_latency_p99_burn"]
+    # Dead silence: the histogram count stops moving while its p99 stays
+    # over threshold. No new evidence → no new samples → the burst ages
+    # out of the short window and the alert clears.
+    st = ev.ingest(_agg(20, 0, p99=0.9), now=20.0)
+    assert not st["burning"]
+    assert st["objectives"]["latency_p99"]["burn_short"] == 0.0
+
+
+def test_slo_quiet_windows_do_not_alert():
+    """No traffic at all (total 0 in every window) is burn 0 — an idle
+    fleet never pages."""
+    ev = SloEvaluator(
+        default_objectives(), registry=Registry(),
+        short_window_s=10.0, long_window_s=30.0,
+    )
+    for t in range(5):
+        st = ev.ingest(_agg(0, 0), now=float(t))
+    assert not st["burning"]
+    assert st["objectives"]["availability"]["burn_short"] == 0.0
+
+
+# ------------------------------------------------------------- stitching ----
+def _span(ts, path, wall_s, trace_id=None, **ident):
+    ev = {
+        "event": "telemetry.span", "ts": ts, "path": path, "wall_s": wall_s,
+    }
+    if trace_id is not None:
+        ev["trace_id"] = trace_id
+    ev.update(ident)
+    return ev
+
+
+def _write_jsonl(path, events):
+    with open(path, "w", encoding="utf-8") as fh:
+        for ev in events:
+            fh.write(json.dumps(ev) + "\n")
+    return str(path)
+
+
+def test_stitch_clock_alignment_and_flow_join(tmp_path):
+    """Synthetic two-process capture: the replica clock runs 2 s behind
+    the coordinator, the handshake offset realigns it, and the request
+    flow joins across captures by trace_id with the expected slack."""
+    router_events = [
+        {
+            "event": stitch.CLOCK_SYNC_EVENT, "ts": 90.0, "replica": "r0",
+            "pid": 123, "platform": "cpu", "offset_s": 2.0,
+        },
+        # Span events stamp ts at EXIT: this dispatch ran [99.0, 100.0].
+        _span(100.0, "fleet/dispatch", 1.0, trace_id="t1"),
+    ]
+    replica_events = [
+        _span(
+            98.35, "serve/dispatch", 0.8, trace_id="t1",
+            replica="r0", pid=123, platform="cpu",
+        ),
+        _span(
+            98.2, "serve/dispatch/score", 0.5, trace_id="t1",
+            replica="r0", pid=123, platform="cpu",
+        ),
+        # An untraced span never joins a flow.
+        _span(98.0, "score/pack", 0.01, replica="r0", pid=123),
+    ]
+    paths = [
+        _write_jsonl(tmp_path / "router.jsonl", router_events),
+        _write_jsonl(tmp_path / "replica-r0.jsonl", replica_events),
+    ]
+    caps = stitch.load_captures(paths)
+    by_label = {c["label"]: c for c in caps}
+    assert by_label["router"]["offset_s"] == 0.0
+    assert by_label["r0"]["offset_s"] == 2.0
+    assert by_label["r0"]["identity"]["pid"] == 123
+
+    flows = stitch.trace_flows(caps)
+    assert set(flows) == {"t1"}
+    spans = flows["t1"]
+    assert [s["path"] for s in spans] == [
+        "fleet/dispatch", "serve/dispatch", "serve/dispatch/score",
+    ]
+    # Aligned starts: replica start 98.35+2.0-0.8 = 99.55, after the
+    # router's 99.0 — the 2 s skew is gone.
+    assert spans[0]["start_s"] == pytest.approx(99.0)
+    assert spans[1]["start_s"] == pytest.approx(99.55)
+    slack = stitch.nesting_slack_s(spans)
+    assert slack == pytest.approx(0.2)
+    # An incomplete chain is None, not a fake pass.
+    assert stitch.nesting_slack_s(spans[:2]) is None
+
+
+def test_stitch_last_handshake_wins():
+    events = [
+        {"event": stitch.CLOCK_SYNC_EVENT, "replica": "r0", "offset_s": 1.0},
+        {"event": stitch.CLOCK_SYNC_EVENT, "replica": "r0", "offset_s": 3.5},
+        {"event": stitch.CLOCK_SYNC_EVENT, "replica": "r1", "offset_s": -0.5},
+        {"event": stitch.CLOCK_SYNC_EVENT, "replica": None, "offset_s": 9.0},
+    ]
+    assert stitch.clock_offsets(events) == {"r0": 3.5, "r1": -0.5}
+
+
+def test_stitch_cli_writes_perfetto_trace(tmp_path):
+    router = _write_jsonl(tmp_path / "router.jsonl", [
+        {
+            "event": stitch.CLOCK_SYNC_EVENT, "ts": 1.0, "replica": "r0",
+            "offset_s": 0.25,
+        },
+        _span(10.0, "fleet/dispatch", 0.5, trace_id="t1", tid=1),
+    ])
+    replica = _write_jsonl(tmp_path / "replica-r0.jsonl", [
+        _span(
+            9.9, "serve/dispatch", 0.3, trace_id="t1",
+            replica="r0", pid=7, platform="cpu", tid=2,
+        ),
+    ])
+    out = tmp_path / "out" / "stitched.json"
+    assert stitch.main([router, replica, "-o", str(out)]) == 0
+    trace = json.loads(out.read_text())
+    names = {
+        ev["args"]["name"] for ev in trace["traceEvents"]
+        if ev.get("name") == "process_name"
+    }
+    assert names == {"router", "r0 (pid 7)"}
+    spans = [
+        ev for ev in trace["traceEvents"] if ev.get("cat") == "span"
+    ]
+    assert {ev["name"] for ev in spans} == {
+        "fleet/dispatch", "serve/dispatch",
+    }
+    # Distinct pids per capture; timestamps non-negative microseconds.
+    assert len({ev["pid"] for ev in spans}) == 2
+    assert all(ev["ts"] >= 0 for ev in spans)
+    # trace_id survives into args — the Perfetto flow-query handle.
+    assert all(ev["args"].get("trace_id") == "t1" for ev in spans)
+    # Usage errors exit 2, never raise.
+    assert stitch.main([]) == 2
+    assert stitch.main(["-o"]) == 2
+
+
+# ------------------------------------------------------- bench smoke gate ---
+def test_bench_smoke_obs_trimmed(tmp_path):
+    """Tier-1-sized observability smoke over a real 2-replica subprocess
+    fleet: aggregate exactness (incl. the drained member), a stitched
+    cross-process flow with non-negative slack, burn-rate trip AND
+    clear, zero scrape failures — hard-gated exactly like the CI gate."""
+    import bench
+
+    result = bench.smoke_obs(str(tmp_path / "obs.jsonl"), trimmed=True)
+    assert result["ok"], result
+    assert result["dropped_responses"] == 0
+    assert result["argmax_parity"] == 1.0
+    assert result["aggregate_exact"] and result["retained_members"]
+    assert result["agg_scrape_failures"] == 0
+    assert result["slo_alerts"] >= 1 and result["burn_cleared"]
+    assert "slo_availability_burn" in result["burn_reasons"]
+    assert not result["final_burning"]
+    assert result["cross_process_flows"] >= 1
+    assert result["nesting_slack_s"] is not None
+    assert result["nesting_slack_s"] >= 0.0
+    assert result["server_timing_sample"] is not None
+    assert set(result["server_timing_sample"]) >= {
+        "queue_wait_ms", "dispatch_ms", "rows_coalesced",
+    }
+    assert result["server_identity_sample"]["replica"]
+
+
+@pytest.mark.slow
+def test_bench_smoke_obs_full(tmp_path):
+    import bench
+
+    result = bench.smoke_obs(str(tmp_path / "obs_full.jsonl"))
+    assert result["ok"], result
+    assert result["scale_downs"] >= 1

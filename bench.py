@@ -1653,6 +1653,7 @@ def smoke_fleet(jsonl_path: str | None = None, *, trimmed: bool = False) -> dict
     from spark_languagedetector_tpu.resilience.policy import RetryPolicy
     from spark_languagedetector_tpu.serve.client import ServeClient, ServeHTTPError
     from spark_languagedetector_tpu.serve.fleet import ServeFleet
+    from spark_languagedetector_tpu.serve.quarantine import QuarantineTable
     from spark_languagedetector_tpu.serve.router import RouterServer
     from spark_languagedetector_tpu.telemetry import REGISTRY
     from spark_languagedetector_tpu.telemetry.export import JsonlSink
@@ -1697,6 +1698,10 @@ def smoke_fleet(jsonl_path: str | None = None, *, trimmed: bool = False) -> dict
             probe_interval_ms=40.0, breaker_threshold=2,
             breaker_cooldown_s=0.3, probe_timeout_s=2.0,
             drain_timeout_s=5.0,
+            # This drill kills replicas under a tiny rotating text set
+            # on purpose; quarantine would 422 its own benign traffic.
+            # The storm smoke drills quarantine with its own table.
+            quarantine=QuarantineTable(0, name="fleet-smoke-off"),
         ),
         max_wait_ms=4, max_rows=64, max_queue_rows=512,
     ).start()
@@ -1863,6 +1868,345 @@ def smoke_fleet(jsonl_path: str | None = None, *, trimmed: bool = False) -> dict
         and interleaved_streams == 0
         and len(final_health["ready_replicas"]) == 3
     )
+    REGISTRY.remove_sink(sink)
+    return result
+
+
+def smoke_storm(jsonl_path: str | None = None, *, trimmed: bool = False) -> dict:
+    """CPU-safe storm smoke: the storm-defense stack end to end
+    (docs/RESILIENCE.md §7) against a live 3-replica fleet behind the
+    router's HTTP front — client -> router -> fleet over real sockets.
+
+    Four scripted legs, each deterministic (manual probe rounds, seeded
+    chaos plans, sequential traffic):
+
+    1. **Query of death.** A replica is killed and a poison batch sent
+       repeatedly: each send's first dispatch lands on the corpse
+       (deterministic least-outstanding/index routing), the router
+       records a correlated death against the batch's content signature
+       and fails over, and after K=2 deaths the signature is quarantined
+       — the third send answers 422 *before* any dispatch and the
+       request lands in the serve DLQ. A control batch keeps serving
+       throughout. Plus one sub-floor-deadline request, which must 504
+       without burning a replica slot.
+    2. **Outage under a retry budget.** The replica is killed again with
+       a nearly-empty budget (burst=1): the first failover spends the
+       only token, the next is *denied* — an explicit budget shed — and
+       total dispatches stay within the token-bucket bound
+       ``offered * (1 + fraction) + burst``.
+    3. **Hedging vs an injected straggler.** The same seeded
+       ``fleet/dispatch:delay`` plan runs twice — hedge off, then hedge
+       on — so the straggler schedule is identical; the hedged run must
+       observably cut p99.
+    4. **Overload: hedges self-disable.** Same stragglers plus
+       ``serve/admit`` sheds, with a drained budget: every hedge arm is
+       denied (``fleet/hedges`` delta must be ZERO) and every request
+       either answers or sheds explicitly with a Retry-After.
+
+    Hard gates (``main()`` exits nonzero): >=1 eligible replica at every
+    checkpoint, the poison quarantined after exactly K deaths and
+    422-rejected thereafter, the amplification bound in leg 2, argmax
+    parity exactly 1.0 on every answered request across all legs, p99
+    cut in the hedge leg, zero hedges in the overload leg.
+    """
+    import tempfile
+
+    from spark_languagedetector_tpu import LanguageDetector, Table
+    from spark_languagedetector_tpu.ops.encoding import texts_to_bytes
+    from spark_languagedetector_tpu.resilience.faults import (
+        FaultPlan,
+        plan_scope,
+    )
+    from spark_languagedetector_tpu.resilience.policy import RetryBudget
+    from spark_languagedetector_tpu.serve.client import ServeClient, ServeHTTPError
+    from spark_languagedetector_tpu.serve.fleet import ServeFleet
+    from spark_languagedetector_tpu.serve.quarantine import QuarantineTable
+    from spark_languagedetector_tpu.serve.router import RouterServer
+    from spark_languagedetector_tpu.telemetry import REGISTRY
+    from spark_languagedetector_tpu.telemetry.export import JsonlSink
+
+    REGISTRY.reset()
+    path = jsonl_path or os.path.join(
+        tempfile.gettempdir(), f"storm_smoke_{os.getpid()}.jsonl"
+    )
+    sink = JsonlSink(path)
+    REGISTRY.add_sink(sink)
+
+    langs = language_names(3)
+    docs, labels = make_corpus(langs, 60, mean_len=200, seed=3)
+    model = LanguageDetector(langs, [1, 2, 3], 200).fit(
+        Table({"lang": labels, "fulltext": docs})
+    )
+    runner = model._get_runner()
+    tmpdir = tempfile.mkdtemp(prefix="storm_smoke_model_")
+    model.save(tmpdir + "/m")
+    dlq_path = tmpdir + "/quarantine_dlq.jsonl"
+
+    outage_n = 16 if trimmed else 30
+    hedge_n = 16 if trimmed else 24
+    overload_n = 12 if trimmed else 16
+    straggle_s = 0.15 if trimmed else 0.2
+    victim = "r0"  # lowest index: the deterministic tie-break sends an
+    # idle fleet's first dispatch here, so a killed r0 IS the first hop.
+
+    # Manual probing (start(probe=False) + probe_once()) keeps replica
+    # eligibility script-controlled: a killed replica stays "ready" until
+    # its dispatch failures eject it, which is exactly the mid-flight
+    # death the quarantine correlates.
+    fleet = ServeFleet.from_path(
+        tmpdir + "/m", replicas=3,
+        router_kw=dict(
+            probe_interval_ms=40.0, breaker_threshold=2,
+            breaker_cooldown_s=0.3, probe_timeout_s=2.0,
+            drain_timeout_s=5.0, deadline_floor_ms=5.0,
+            retry_budget=RetryBudget(0.2, 10.0, name="storm"),
+            quarantine=QuarantineTable(2, dlq_path=dlq_path, name="storm"),
+            hedge_enable=False, hedge_quantile=0.05, hedge_min_ms=25.0,
+        ),
+        max_wait_ms=4, max_rows=64, max_queue_rows=512,
+    ).start(probe=False)
+    router = fleet.router
+    front = RouterServer(router, fleet=fleet, port=0).start()
+    host, port = front.address
+    client = ServeClient(host, port)
+
+    answered: list[tuple[list, list]] = []  # (texts, labels) for parity
+    gates: dict[str, bool] = {}
+    survival_checks: list[int] = []
+
+    def counter(name: str) -> int:
+        return int(REGISTRY.snapshot()["counters"].get(name, 0))
+
+    def checkpoint() -> None:
+        survival_checks.append(len(router.eligible()))
+
+    def ask(texts: list) -> list | None:
+        """One /detect; successes feed the parity ledger, sheds and
+        rejections return None."""
+        try:
+            got, _meta = client.detect(texts)
+        except (ServeHTTPError, OSError):
+            return None
+        answered.append((texts, got))
+        return got
+
+    def reprobe_all(timeout_s: float = 10.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            router.probe_once()
+            if len(router.eligible()) == 3:
+                return True
+            time.sleep(0.05)
+        return len(router.eligible()) == 3
+
+    # ---- leg 1: query of death -> quarantine + deadline floor ----------
+    poison = [f"query of death {i} ☠ {os.getpid()}" for i in range(4)]
+    control = docs[0:4]
+    fleet.replica(victim).kill()
+    checkpoint()
+    ask(poison)   # death 1 on the corpse, failover answers
+    ask(poison)   # death 2 -> quarantined (K=2)
+    q = router.quarantine.describe()
+    gates["poison_quarantined_at_k"] = (
+        len(q["quarantined"]) == 1 and q["deaths_threshold"] == 2
+    )
+    poison_status = 0
+    try:
+        client.detect(poison)
+    except ServeHTTPError as e:
+        poison_status = e.status
+        gates["poison_422_flagged"] = bool(e.payload.get("quarantined"))
+    gates["poison_rejected_422"] = poison_status == 422
+    gates["poison_dlq_written"] = (
+        os.path.exists(dlq_path) and len(router.quarantine.dlq) >= 1
+    )
+    gates["control_survives_quarantine"] = ask(control) is not None
+    deadline_status = 0
+    try:
+        client.detect(control, deadline_ms=2.0)  # below the 5ms floor
+    except ServeHTTPError as e:
+        deadline_status = e.status
+    gates["subfloor_deadline_504"] = (
+        deadline_status == 504 and counter("fleet/deadline_rejects") >= 1
+    )
+    checkpoint()
+    fleet.replica(victim).revive()
+    time.sleep(0.35)  # breaker cooldown before the half-open probe
+    gates["victim_readmitted"] = reprobe_all()
+
+    # ---- leg 2: outage under a nearly-empty retry budget ---------------
+    router.retry_budget = RetryBudget(0.05, 1.0, name="storm-outage")
+    base_dispatch = counter("fleet/dispatches")
+    base_shed = counter("fleet/shed_requests")
+    base_exhausted = counter("fleet/retry_budget_exhausted")
+    fleet.replica(victim).kill()
+    checkpoint()
+    outage_answered = 0
+    for i in range(outage_n):
+        lo = (i * 3) % (len(docs) - 3)
+        if ask(docs[lo:lo + 3]) is not None:
+            outage_answered += 1
+    dispatches = counter("fleet/dispatches") - base_dispatch
+    amplification = dispatches / outage_n
+    # The token-bucket bound: extra attempts <= fraction*successes + burst.
+    amp_bound = 1.0 + 0.05 + 1.0 / outage_n + 1e-9
+    gates["retry_amplification_bounded"] = amplification <= amp_bound
+    gates["budget_shed_observed"] = (
+        counter("fleet/shed_requests") - base_shed >= 1
+        and counter("fleet/retry_budget_exhausted") - base_exhausted >= 1
+    )
+    # Exactly one request is budget-shed; everything else must answer.
+    gates["outage_goodput_held"] = outage_answered >= outage_n - 1
+    checkpoint()
+    fleet.replica(victim).revive()
+    time.sleep(0.35)
+    gates["victim_readmitted_again"] = reprobe_all()
+
+    # ---- leg 3: hedging vs an injected straggler (same schedule 2x) ----
+    router.retry_budget = RetryBudget(0.5, 10.0, name="storm-hedge")
+    plan = f"seed=11;fleet/dispatch:delay={straggle_s}%0.35"
+
+    def drive_hedge_leg() -> list[float]:
+        lats = []
+        with plan_scope(FaultPlan.parse(plan)):
+            for i in range(hedge_n):
+                lo = (i * 2) % (len(docs) - 4)
+                t0 = time.perf_counter()
+                ask(docs[lo:lo + 4])
+                lats.append(time.perf_counter() - t0)
+        return lats
+
+    lat_off = drive_hedge_leg()
+    router.hedge_enable = True
+    base_hedges = counter("fleet/hedges")
+    lat_on = drive_hedge_leg()
+    hedges = counter("fleet/hedges") - base_hedges
+    hedge_wins = counter("fleet/hedge_wins")
+    p99_off = float(np.percentile(lat_off, 99))
+    p99_on = float(np.percentile(lat_on, 99))
+    gates["hedges_fired"] = hedges >= 1 and hedge_wins >= 1
+    # Identical straggler schedule (same plan+seed, and hedges inject at
+    # fleet/hedge so the primary-side call counter stays aligned): the
+    # hedged run must measurably rescue the injected tail.
+    gates["hedge_cut_p99"] = (
+        p99_off >= straggle_s and p99_on <= 0.75 * p99_off
+    )
+    checkpoint()
+
+    # ---- leg 4: overload -> hedges self-disable on the drained budget --
+    drained = RetryBudget(0.05, 1.0, name="storm-overload")
+    drained.try_spend(reason="storm_drain")  # the storm already ate it
+    router.retry_budget = drained
+    base_hedges = counter("fleet/hedges")
+    base_exhausted = counter("fleet/retry_budget_exhausted")
+    base_shed = counter("fleet/shed_requests")
+    overload_outcomes = []  # "answered" | "shed" | error repr
+    with plan_scope(FaultPlan.parse(
+        f"seed=13;fleet/dispatch:delay={straggle_s}%0.35;"
+        "serve/admit:error%0.3"
+    )):
+        for i in range(overload_n):
+            lo = (i * 5) % (len(docs) - 3)
+            try:
+                got, _meta = client.detect(docs[lo:lo + 3])
+            except ServeHTTPError as e:
+                overload_outcomes.append(
+                    "shed" if e.status == 503 and e.retry_after_s > 0
+                    else f"HTTP {e.status}"
+                )
+                continue
+            except OSError as e:
+                overload_outcomes.append(repr(e))
+                continue
+            answered.append((docs[lo:lo + 3], got))
+            overload_outcomes.append("answered")
+    gates["overload_zero_hedges"] = (
+        counter("fleet/hedges") - base_hedges == 0
+    )
+    gates["overload_budget_denials"] = (
+        counter("fleet/retry_budget_exhausted") - base_exhausted >= 1
+    )
+    gates["overload_answer_or_shed"] = all(
+        o in ("answered", "shed") for o in overload_outcomes
+    )
+    gates["overload_shed_observed"] = (
+        counter("fleet/shed_requests") - base_shed >= 1
+    )
+    checkpoint()
+    gates["fleet_survived"] = min(survival_checks) >= 1 and reprobe_all()
+
+    final_health = router.healthz()
+    front.stop()
+    fleet.close()
+
+    # Parity: every answered request, every leg, against the direct
+    # runner — label-exact (argmax), including hedge-won responses.
+    checked = mismatches = 0
+    for texts, got in answered:
+        ids = runner.predict_ids(texts_to_bytes(texts))
+        want = [langs[int(i)] for i in ids]
+        checked += 1
+        if got != want:
+            mismatches += 1
+    parity = 1.0 if checked and mismatches == 0 else (
+        round(1.0 - mismatches / checked, 6) if checked else 0.0
+    )
+    gates["argmax_parity"] = parity == 1.0
+
+    failed = sorted(k for k, v in gates.items() if not v)
+    result = {
+        "smoke_storm": True,
+        "trimmed": trimmed,
+        "replicas": 3,
+        "answered": len(answered),
+        "argmax_parity": parity,
+        "poison": {
+            "status": poison_status,
+            "deaths_threshold": 2,
+            "quarantined": router.quarantine.describe()["quarantined"],
+            "dlq_rows": len(router.quarantine.dlq),
+        },
+        "outage": {
+            "offered": outage_n,
+            "answered": outage_answered,
+            "dispatches": dispatches,
+            "amplification": round(amplification, 4),
+            "amplification_bound": round(amp_bound, 4),
+        },
+        "hedge": {
+            "fired": hedges,
+            "wins": hedge_wins,
+            "p99_off_ms": round(p99_off * 1e3, 3),
+            "p99_on_ms": round(p99_on * 1e3, 3),
+        },
+        "overload": {
+            "offered": overload_n,
+            "outcomes": {
+                o: overload_outcomes.count(o)
+                for o in sorted(set(overload_outcomes))
+            },
+            "hedges": counter("fleet/hedges") - base_hedges,
+        },
+        "counters": {
+            k: counter(k) for k in (
+                "fleet/dispatches", "fleet/failovers",
+                "fleet/deadline_rejects", "fleet/retry_budget_exhausted",
+                "fleet/quarantined_signatures", "fleet/quarantine_rejects",
+                "fleet/shed_requests", "fleet/hedges", "fleet/hedge_wins",
+            )
+        },
+        "survival_checks": survival_checks,
+        "gates": gates,
+        "errors": [f"gate failed: {k}" for k in failed],
+        "health": {
+            "ready_replicas": final_health["ready_replicas"],
+            "retry_budget": final_health["retry_budget"],
+            "quarantine": final_health["quarantine"],
+            "hedging": final_health["hedging"],
+        },
+        "telemetry": telemetry_block(path),
+    }
+    result["ok"] = not failed
     REGISTRY.remove_sink(sink)
     return result
 
@@ -4500,6 +4844,36 @@ def main():
                     "; ".join(result["errors"])
                     or "gate (drop/parity/failover/ejection/readmission/"
                     "swap-atomicity) not met"
+                ),
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        return
+    if "--smoke-storm" in sys.argv[1:]:
+        # Storm smoke path: the storm-defense stack (deadline decay,
+        # retry budget, hedged dispatch, query-of-death quarantine;
+        # docs/RESILIENCE.md §7) against a live 3-replica fleet. Gates:
+        # fleet survival, poison quarantined after <=K deaths + 422,
+        # bounded retry amplification, parity 1.0, hedge p99 cut, zero
+        # hedges under overload.
+        args = [a for a in sys.argv[1:] if a != "--smoke-storm"]
+        flags = [a for a in args if a.startswith("-")]
+        if flags or len(args) > 1:
+            print(
+                f"usage: python bench.py --smoke-storm [out.jsonl] "
+                f"(got {args})",
+                file=sys.stderr,
+            )
+            sys.exit(2)
+        result = smoke_storm(args[0] if args else None)
+        print(json.dumps(result), flush=True)
+        if not result["ok"]:
+            print(
+                "storm smoke FAILED: "
+                + (
+                    "; ".join(result["errors"])
+                    or "gate (survival/quarantine/amplification/parity/"
+                    "hedge/overload) not met"
                 ),
                 file=sys.stderr,
             )

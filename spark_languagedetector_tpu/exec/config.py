@@ -142,6 +142,34 @@ KNOBS: dict[str, Knob] = _knobs(
     Knob("fleet_drain_timeout_s", "LANGDETECT_FLEET_DRAIN_TIMEOUT_S",
          "float", 10.0, "per-replica drain bound during the two-phase "
          "fleet swap", positive=True),
+    # --- storm defense (budget + hedge + quarantine: RESILIENCE.md §7) ----
+    Knob("fleet_deadline_floor_ms", "LANGDETECT_FLEET_DEADLINE_FLOOR_MS",
+         "float", 5.0, "remaining-deadline floor below which the router "
+         "504s instead of burning another replica", positive=True),
+    Knob("retry_budget_fraction", "LANGDETECT_RETRY_BUDGET_FRACTION",
+         "float", 0.2, "retry-budget tokens deposited per success "
+         "(0: budget off, retries ungated)"),
+    Knob("retry_budget_burst", "LANGDETECT_RETRY_BUDGET_BURST", "float",
+         10.0, "retry-budget token cap and starting balance",
+         positive=True),
+    Knob("hedge_enable", "LANGDETECT_HEDGE_ENABLE", "bool", False,
+         "hedged fleet dispatch: second replica tried after the observed "
+         "latency-quantile delay"),
+    Knob("hedge_quantile", "LANGDETECT_HEDGE_QUANTILE", "float", 0.95,
+         "observed dispatch-latency quantile that arms the hedge timer",
+         positive=True),
+    Knob("hedge_min_ms", "LANGDETECT_HEDGE_MIN_MS", "float", 10.0,
+         "hedge-delay floor (also the delay before latency history "
+         "exists)", positive=True),
+    Knob("quarantine_deaths", "LANGDETECT_QUARANTINE_DEATHS", "int", 2,
+         "correlated replica deaths that quarantine a request signature",
+         positive=True),
+    Knob("quarantine_max_entries", "LANGDETECT_QUARANTINE_MAX_ENTRIES",
+         "int", 4096, "suspect/quarantine signature-table bound (oldest "
+         "evicted first)", positive=True),
+    Knob("quarantine_dlq_path", "LANGDETECT_QUARANTINE_DLQ_PATH", "str",
+         None, "serve-level dead-letter JSONL for quarantined "
+         "query-of-death signatures"),
     # --- elastic scale (subprocess replicas + autoscaler: scale/) ---------
     Knob("scale_min", "LANGDETECT_SCALE_MIN", "int", 1,
          "autoscaler floor: minimum live replicas", positive=True),

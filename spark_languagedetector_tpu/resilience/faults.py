@@ -89,6 +89,18 @@ Sites (one hook per serving layer; docs/RESILIENCE.md §4):
     the tick loop — so the aggregate-staleness (SLO freshness) and
     scrape-failure-regression paths replay deterministically
     (docs/OBSERVABILITY.md §14).
+  * ``fleet/hedge``    — each *hedge* dispatch attempt the router issues
+    (:meth:`serve.router.FleetRouter`'s hedged dispatch, docs/
+    RESILIENCE.md §7): a firing ``error`` kills that hedge in flight —
+    the primary still answers, so an injected hedge fault costs the
+    latency win but never the request; ``delay`` makes the hedge itself
+    the straggler, exercising primary-wins-first ordering.
+  * ``fleet/quarantine`` — each query-of-death table operation (every
+    quarantine lookup and every correlated-death record,
+    :mod:`serve.quarantine`): a firing ``error`` degrades that one
+    operation *open* — a failed lookup answers "not quarantined", a
+    failed record drops the observation — so chaos can delay poison
+    protection but can never reject a healthy request.
 """
 
 from __future__ import annotations
@@ -122,6 +134,8 @@ SITES = (
     "scale/spawn",
     "scale/decision",
     "fleet/scrape",
+    "fleet/hedge",
+    "fleet/quarantine",
 )
 
 KINDS = ("error", "delay", "poison")

@@ -258,6 +258,113 @@ class RetryPolicy:
             return result
 
 
+# --- retry budget ------------------------------------------------------------
+RETRY_BUDGET_FRACTION_ENV = "LANGDETECT_RETRY_BUDGET_FRACTION"
+RETRY_BUDGET_BURST_ENV = "LANGDETECT_RETRY_BUDGET_BURST"
+
+
+class RetryBudget:
+    """Token-bucket retry budget: retries as a fraction of successes.
+
+    The metastable-failure guard (docs/RESILIENCE.md "Storm defense"):
+    every *success* deposits ``fraction`` tokens (capped at ``burst``,
+    which is also the starting balance — a quiet service can absorb a
+    small incident immediately), and every retry-shaped extra attempt —
+    a router failover, a client 503 re-send, a hedge — must withdraw one
+    whole token first. During an outage successes dry up, the bucket
+    drains, and retry amplification is bounded by
+    ``burst + fraction × successes`` over any window instead of
+    multiplying the offered load. A denied withdrawal is an explicit shed
+    (``fleet/retry_budget_exhausted``), never a queued hope.
+
+    ``fraction <= 0`` disables the budget: :meth:`try_spend` always
+    grants, preserving the un-budgeted legacy behavior. Thread-safe; the
+    live balance is exported as ``langdetect_retry_budget_tokens``.
+    """
+
+    def __init__(
+        self,
+        fraction: float | None = None,
+        burst: float | None = None,
+        *,
+        name: str = "fleet",
+    ):
+        from ..exec import config as exec_config
+
+        self.fraction = float(
+            exec_config.resolve("retry_budget_fraction", fraction)
+        )
+        self.burst = max(
+            1.0, float(exec_config.resolve("retry_budget_burst", burst))
+        )
+        self.name = name
+        self._lock = threading.Lock()
+        self._tokens = self.burst
+        self._successes = 0
+        self._spent = 0
+        self._denied = 0
+        self._gauge()
+
+    @property
+    def enabled(self) -> bool:
+        return self.fraction > 0.0
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+    def _gauge(self) -> None:
+        REGISTRY.set_gauge(
+            "langdetect_retry_budget_tokens", round(self._tokens, 6),
+            budget=self.name,
+        )
+
+    def record_success(self) -> None:
+        """Deposit for one successful (non-retry) unit of work."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._successes += 1
+            self._tokens = min(self.burst, self._tokens + self.fraction)
+            self._gauge()
+
+    def try_spend(self, *, reason: str = "retry") -> bool:
+        """Withdraw one token for an extra attempt; False ⇒ shed it."""
+        if not self.enabled:
+            return True
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                self._spent += 1
+                granted = True
+            else:
+                self._denied += 1
+                granted = False
+            self._gauge()
+        if not granted:
+            REGISTRY.incr("fleet/retry_budget_exhausted")
+            log_event(
+                _log, "resilience.retry_budget.exhausted",
+                budget=self.name, reason=reason, fraction=self.fraction,
+            )
+        return granted
+
+    def describe(self) -> dict:
+        """Budget state for /varz and the storm drill's assertions."""
+        with self._lock:
+            return {
+                "name": self.name,
+                "enabled": self.enabled,
+                "fraction": self.fraction,
+                "burst": self.burst,
+                "tokens": round(self._tokens, 6),
+                "successes": self._successes,
+                "spent": self._spent,
+                "denied": self._denied,
+            }
+
+
 # --- circuit breaker ---------------------------------------------------------
 BREAKER_THRESHOLD_ENV = "LANGDETECT_BREAKER_THRESHOLD"
 BREAKER_COOLDOWN_ENV = "LANGDETECT_BREAKER_COOLDOWN_S"

@@ -249,6 +249,13 @@ class ElasticFleet:
         events = self.supervisor.poll_once()
         for ev in events:
             name, _, what = ev.partition(":")
+            # Every supervision event here began as a detected death
+            # (restarted or gave up): charge the signature last routed
+            # to that member in the query-of-death table, so a poison
+            # request that kills subprocess replicas out-of-band (the
+            # router never saw a connection drop) still hits its K-death
+            # quarantine bound (docs/RESILIENCE.md §7).
+            self.router.quarantine.replica_died(name, source="supervisor")
             if what == "gave_up":
                 try:
                     self.router.remove_replica(name, drain=False)

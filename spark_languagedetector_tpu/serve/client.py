@@ -16,11 +16,19 @@ the *idempotent* calls (``score``/``detect`` and every GET) ride it: a
 503 shed sleeps ``max(Retry-After, seeded-jitter backoff)`` and retries,
 bounded by ``max_attempts`` — the client-side half of load shedding
 (the server asks for a later retry; the client grants it). 400 (caller
-bug) and 504 (blown deadline) are never retried; connection-level
-failures ride the same :func:`~..resilience.policy.is_retryable`
-taxonomy the serving layers use. Admin calls (``swap``/``rollback``)
-never retry — replaying a non-idempotent mutation is the caller's
-decision, not the transport's.
+bug), 422 (quarantined query of death), and 504 (blown deadline) are
+never retried; connection-level failures ride the same
+:func:`~..resilience.policy.is_retryable` taxonomy the serving layers
+use. Admin calls (``swap``/``rollback``) never retry — replaying a
+non-idempotent mutation is the caller's decision, not the transport's.
+
+Two storm-defense bounds (docs/RESILIENCE.md §7) cap the retry loop: a
+request that carries ``deadline_ms`` never *sleeps* past its own
+deadline (a backoff that would end after it surfaces the last error
+instead — ``serve/client_deadline_gaveups``), and an attached
+:class:`~..resilience.policy.RetryBudget` charges one token per retry so
+a client herd cannot amplify an outage beyond the configured fraction of
+its own successful traffic.
 """
 
 from __future__ import annotations
@@ -32,7 +40,7 @@ from typing import Sequence
 
 import numpy as np
 
-from ..resilience.policy import RetryPolicy, is_retryable
+from ..resilience.policy import RetryBudget, RetryPolicy, is_retryable
 from ..telemetry import REGISTRY
 from ..utils.logging import get_logger, log_event
 
@@ -79,12 +87,18 @@ class ServeClient:
         *,
         timeout_s: float = 60.0,
         retry_policy: RetryPolicy | None = None,
+        retry_budget: RetryBudget | None = None,
         tenant: str | None = None,
     ):
         self.host = host
         self.port = port
         self.timeout_s = timeout_s
         self.retry_policy = retry_policy
+        # Optional storm-defense budget (docs/RESILIENCE.md §7): when
+        # set, each retry withdraws one token (successes deposit), so a
+        # fleet of clients cannot amplify an outage past the configured
+        # fraction of its own successful traffic.
+        self.retry_budget = retry_budget
         self.tenant = tenant
 
     # ------------------------------------------------------------- wire -----
@@ -121,15 +135,20 @@ class ServeClient:
         payload: dict | None = None,
         *,
         idempotent: bool | None = None,
+        deadline_s: float | None = None,
     ):
         if idempotent is None:
             idempotent = method == "GET"
         policy = self.retry_policy
+        budget = self.retry_budget
         attempt = 0
         while True:
             attempt += 1
             try:
-                return self._request_once(method, path, payload)
+                result = self._request_once(method, path, payload)
+                if budget is not None:
+                    budget.record_success()
+                return result
             except Exception as e:
                 if (
                     policy is None
@@ -145,6 +164,25 @@ class ServeClient:
                 delay = policy.backoff_s(attempt)
                 if isinstance(e, ServeHTTPError):
                     delay = max(delay, e.retry_after_s)
+                if deadline_s is not None:
+                    # The request carries a deadline: total retry wall
+                    # time is bounded by it. A sleep that would end at or
+                    # past the deadline buys a retry whose answer is
+                    # already worthless — surface the last error instead.
+                    remaining = deadline_s - time.monotonic()
+                    if remaining <= 0 or delay >= remaining:
+                        REGISTRY.incr("serve/client_deadline_gaveups")
+                        log_event(
+                            _log, "serve.client.deadline_gaveup",
+                            path=path, attempt=attempt,
+                            backoff_s=round(delay, 6),
+                            remaining_s=round(remaining, 6),
+                        )
+                        raise
+                if budget is not None and not budget.try_spend(
+                    reason="client_retry"
+                ):
+                    raise
                 REGISTRY.incr("serve/client_retries")
                 log_event(
                     _log, "serve.client.retry", path=path, attempt=attempt,
@@ -174,12 +212,17 @@ class ServeClient:
         bit-transparent for float32 (exact f64 embed + round-tripping
         doubles), so these scores equal the server-side arrays exactly."""
         payload: dict = {"texts": list(texts), "priority": priority}
+        deadline_s = None
         if deadline_ms is not None:
             payload["deadline_ms"] = deadline_ms
+            deadline_s = time.monotonic() + float(deadline_ms) / 1e3
         if trace_id is not None:
             payload["trace_id"] = trace_id
         self._tenant_key(payload, tenant)
-        data = self._request("POST", "/score", payload, idempotent=True)
+        data = self._request(
+            "POST", "/score", payload, idempotent=True,
+            deadline_s=deadline_s,
+        )
         scores = np.asarray(data.pop("scores"), dtype=np.float32)
         if scores.size == 0:
             scores = scores.reshape(0, 0)
@@ -200,12 +243,17 @@ class ServeClient:
         (``meta["mode"] == "segment"`` says which came back); use
         :meth:`segment` to request that shape explicitly."""
         payload: dict = {"texts": list(texts), "priority": priority}
+        deadline_s = None
         if deadline_ms is not None:
             payload["deadline_ms"] = deadline_ms
+            deadline_s = time.monotonic() + float(deadline_ms) / 1e3
         if trace_id is not None:
             payload["trace_id"] = trace_id
         self._tenant_key(payload, tenant)
-        data = self._request("POST", "/detect", payload, idempotent=True)
+        data = self._request(
+            "POST", "/detect", payload, idempotent=True,
+            deadline_s=deadline_s,
+        )
         if "results" in data:
             return data.pop("results"), data
         return data.pop("labels"), data
@@ -232,13 +280,16 @@ class ServeClient:
             payload["top_k"] = top_k
         if reject_threshold is not None:
             payload["reject_threshold"] = reject_threshold
+        deadline_s = None
         if deadline_ms is not None:
             payload["deadline_ms"] = deadline_ms
+            deadline_s = time.monotonic() + float(deadline_ms) / 1e3
         if trace_id is not None:
             payload["trace_id"] = trace_id
         self._tenant_key(payload, tenant)
         data = self._request(
-            "POST", "/detect?mode=segment", payload, idempotent=True
+            "POST", "/detect?mode=segment", payload, idempotent=True,
+            deadline_s=deadline_s,
         )
         return data.pop("results"), data
 

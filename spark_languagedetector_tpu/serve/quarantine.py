@@ -1,0 +1,251 @@
+"""Query-of-death quarantine: stop a poison request from eating the fleet.
+
+A *query of death* is a request whose content deterministically crashes
+whatever replica serves it (an encoder edge case, a pathological
+document). Plain failover makes it worse: the router faithfully replays
+the killer onto the next healthy replica, and a single request takes the
+fleet down serially — the canonical production-fleet failure shape
+(PAPERS.md: arXiv:2204.06514's metastable framing).
+
+This module is the router's memory of that correlation. Every dispatch
+records a **content signature** — the same content identity the serve
+score cache keys on (the document bytes; :mod:`.cache`), hashed with the
+process-independent FNV-1a the fault plane uses, so two routers (and two
+runs) agree on every signature. A dispatch that coincides with a replica
+death (connection severed mid-flight, or the supervisor's crash-loop
+detector reporting the process gone) charges one *correlated death* to
+the signature it carried. At ``K`` deaths (``LANGDETECT_QUARANTINE_
+DEATHS``) the signature is quarantined: the router answers it with an
+explicit 422 (:class:`QueryQuarantined` — a ``ValueError``, so every
+layer already classifies it non-retryable) and records the full request
+to a serve-level dead-letter queue (:class:`~..resilience.dlq.
+DeadLetterQueue` — the same JSONL shape the streaming DLQ writes, so the
+same tooling replays it). A poison request can therefore kill at most K
+replicas, ever.
+
+Both table operations pass the ``fleet/quarantine`` fault site. An
+injected error degrades *open*: a failed lookup answers "not
+quarantined" and a failed death-record drops that one observation —
+chaos can delay protection but can never reject a healthy request.
+
+Bounded: the suspect and quarantined maps evict oldest-first past
+``LANGDETECT_QUARANTINE_MAX_ENTRIES`` — a high-cardinality workload
+cannot grow the table without bound.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Sequence
+
+from ..exec import config as exec_config
+from ..resilience import faults
+from ..resilience.dlq import DeadLetterQueue
+from ..telemetry import REGISTRY
+from ..utils.logging import get_logger, log_event
+
+_log = get_logger("serve.quarantine")
+
+
+class QueryQuarantined(ValueError):
+    """The request's content signature is quarantined (query of death).
+
+    ``ValueError``-shaped on purpose: every serving layer already maps
+    ``ValueError`` to a caller-side 4xx and never retries it — exactly
+    the contract a poison request needs. The HTTP fronts answer it 422
+    (a well-formed request the fleet refuses to re-serve), keeping it
+    distinguishable from a 400 caller bug.
+    """
+
+    def __init__(self, signature: str, deaths: int):
+        super().__init__(
+            f"request signature {signature} is quarantined after "
+            f"{deaths} correlated replica death(s); see the serve DLQ"
+        )
+        self.signature = signature
+        self.deaths = deaths
+
+
+def signature_of(texts: Sequence[str]) -> str:
+    """Content signature of one request: order-sensitive FNV-1a over the
+    document bytes (the cache's content identity, minus the version/mode
+    axes — a killer document kills regardless of model version)."""
+    h = 0xCBF29CE484222325
+    for t in texts:
+        h = (h ^ faults._fnv1a(t)) * 0x100000001B3 & ((1 << 64) - 1)
+        h = (h ^ len(t)) * 0x100000001B3 & ((1 << 64) - 1)
+    return f"{h:016x}"
+
+
+class QuarantineTable:
+    """Correlated-death ledger: signature → deaths, plus the quarantine set.
+
+    Thread-safe. ``note_dispatch`` remembers the last signature routed to
+    each replica so an *out-of-band* death report (the supervisor's
+    crash-loop detector, which sees the process die but not the request)
+    can still charge the right signature via :meth:`replica_died`.
+
+    ``deaths <= 0`` disables the table (mirroring
+    ``RetryBudget(fraction=0)``): nothing is ever suspected or refused.
+    That is the opt-out for drills that slaughter replicas under a tiny
+    repeating text set on purpose — kill/failover exercises would
+    otherwise "poison" their own benign traffic.
+    """
+
+    def __init__(
+        self,
+        deaths: int | None = None,
+        max_entries: int | None = None,
+        *,
+        dlq: DeadLetterQueue | None = None,
+        dlq_path: str | None = None,
+        name: str = "fleet",
+    ):
+        self.deaths_threshold = int(
+            exec_config.resolve("quarantine_deaths", deaths)
+        )
+        self.max_entries = max(
+            1, int(exec_config.resolve("quarantine_max_entries", max_entries))
+        )
+        if dlq is None:
+            path = exec_config.resolve("quarantine_dlq_path", dlq_path)
+            dlq = DeadLetterQueue(path)
+        self.dlq = dlq
+        self.name = name
+        self._lock = threading.Lock()
+        self._suspects: OrderedDict[str, dict] = OrderedDict()
+        self._quarantined: OrderedDict[str, dict] = OrderedDict()
+        self._last_sig: dict[str, tuple[str, list]] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.deaths_threshold >= 1
+
+    # ------------------------------------------------------------- checks ---
+    def check(self, sig: str) -> bool:
+        """Is ``sig`` quarantined? Degrades open under an injected fault."""
+        if not self.enabled:
+            return False
+        try:
+            faults.inject("fleet/quarantine")
+        except Exception as e:
+            log_event(
+                _log, "quarantine.check_degraded", signature=sig,
+                error=repr(e),
+            )
+            return False
+        with self._lock:
+            return sig in self._quarantined
+
+    def note_dispatch(self, replica: str, sig: str, texts: Sequence[str]) -> None:
+        """Remember the signature most recently routed to ``replica`` (the
+        supervisor's death reports arrive without request context)."""
+        if not self.enabled:
+            return
+        preview = [t[:80] for t in texts[:4]]
+        with self._lock:
+            self._last_sig[replica] = (sig, preview)
+
+    # ------------------------------------------------------------- deaths ---
+    def record_death(
+        self,
+        sig: str,
+        *,
+        replica: str | None = None,
+        source: str = "router",
+        texts: Sequence[str] | None = None,
+    ) -> bool:
+        """Charge one correlated replica death to ``sig``; returns True
+        when this death crossed the threshold and quarantined it.
+        Degrades open (death dropped) under an injected fault."""
+        if not self.enabled:
+            return False
+        try:
+            faults.inject("fleet/quarantine")
+        except Exception as e:
+            log_event(
+                _log, "quarantine.record_degraded", signature=sig,
+                error=repr(e),
+            )
+            return False
+        preview = (
+            [t[:80] for t in texts[:4]] if texts is not None else None
+        )
+        with self._lock:
+            if replica is not None:
+                # A charged death consumes the replica's pending
+                # signature: the router's mid-flight charge and the
+                # supervisor's out-of-band report describe the SAME
+                # death event, and must not count it twice (K would
+                # silently halve). The next dispatch re-arms it.
+                self._last_sig.pop(replica, None)
+            if sig in self._quarantined:
+                self._quarantined[sig]["deaths"] += 1
+                return False
+            rec = self._suspects.pop(sig, None)
+            if rec is None:
+                rec = {"deaths": 0, "replicas": [], "preview": preview}
+            self._suspects[sig] = rec  # re-insert: LRU-by-last-death
+            rec["deaths"] += 1
+            if replica is not None:
+                rec["replicas"].append(f"{source}:{replica}")
+            if preview is not None:
+                rec["preview"] = preview
+            deaths = rec["deaths"]
+            newly = deaths >= self.deaths_threshold
+            if newly:
+                self._suspects.pop(sig, None)
+                self._quarantined[sig] = rec
+            while len(self._suspects) > self.max_entries:
+                self._suspects.popitem(last=False)
+            while len(self._quarantined) > self.max_entries:
+                self._quarantined.popitem(last=False)
+            quarantined_n = len(self._quarantined)
+            row = {
+                "signature": sig,
+                "preview": rec["preview"],
+                "replicas": list(rec["replicas"]),
+                "deaths": deaths,
+            }
+        log_event(
+            _log, "quarantine.death", signature=sig, replica=replica,
+            source=source, deaths=deaths, quarantined=newly,
+        )
+        if newly:
+            REGISTRY.incr("fleet/quarantined_signatures")
+            REGISTRY.set_gauge(
+                "langdetect_fleet_quarantined", float(quarantined_n),
+                table=self.name,
+            )
+            self.dlq.put(
+                batch=0, row_index=deaths, row=row,
+                error="query_of_death",
+            )
+        return newly
+
+    def replica_died(self, replica: str, *, source: str = "supervisor") -> bool:
+        """Out-of-band death report (the supervisor's crash-loop detector):
+        charge the signature last routed to ``replica``, if any."""
+        with self._lock:
+            last = self._last_sig.get(replica)
+        if last is None:
+            return False
+        sig, preview = last
+        return self.record_death(
+            sig, replica=replica, source=source,
+            texts=preview,
+        )
+
+    # ------------------------------------------------------------- status ---
+    def describe(self) -> dict:
+        """Table state for /varz and the storm drill's assertions."""
+        with self._lock:
+            return {
+                "name": self.name,
+                "enabled": self.enabled,
+                "deaths_threshold": self.deaths_threshold,
+                "suspects": len(self._suspects),
+                "quarantined": sorted(self._quarantined),
+                "dlq_rows": len(self.dlq),
+            }

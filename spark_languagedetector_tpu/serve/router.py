@@ -35,6 +35,20 @@ thread-safe object owns all of it:
     saturated": the router tries the remaining ready replicas and only
     when every one of them shed does it raise :class:`FleetSaturated`
     (HTTP 503 + the smallest ``Retry-After`` any replica offered).
+  * **Storm defense** (docs/RESILIENCE.md §7). The *remaining* deadline
+    budget decays into every failover attempt's ``deadline_ms`` (a
+    nearly-expired request never occupies N replicas back-to-back), and
+    below ``LANGDETECT_FLEET_DEADLINE_FLOOR_MS`` the router answers 504
+    itself. Every extra attempt — failover or hedge — must withdraw a
+    token from the shared :class:`~..resilience.policy.RetryBudget`, so
+    a replica outage degrades to bounded goodput loss instead of a
+    retry storm. With ``LANGDETECT_HEDGE_ENABLE`` the router issues one
+    *hedge* to a different replica after the observed dispatch-latency
+    quantile delay, first answer wins (sound: scoring is pure, leases
+    pin versions). And a :class:`~.quarantine.QuarantineTable` remembers
+    which content signatures keep coinciding with replica death — a
+    query of death is answered 422 after at most K kills, never replayed
+    onto the whole fleet serially.
 
   * **Dynamic membership.** :meth:`~FleetRouter.add_replica` admits a
     new endpoint mid-flight with a fresh breaker;
@@ -52,18 +66,34 @@ client stream from ever interleaving two model versions.
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
+from collections import deque
 from http.client import HTTPException
 
 from ..exec import config as exec_config
 from ..resilience import faults
-from ..resilience.policy import CLOSED, HALF_OPEN, OPEN, CircuitBreaker, is_retryable
+from ..resilience.policy import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    RetryBudget,
+    is_retryable,
+)
 from ..telemetry import REGISTRY, span
 from ..telemetry.tracing import trace_request
 from ..utils.logging import get_logger, log_event
-from .batcher import INTERACTIVE, LANES, ServeError, ServeOverloaded
+from .batcher import (
+    INTERACTIVE,
+    LANES,
+    ServeDeadlineExceeded,
+    ServeError,
+    ServeOverloaded,
+)
 from .client import ServeClient, ServeHTTPError
+from .quarantine import QuarantineTable, QueryQuarantined, signature_of
 from .server import JsonHTTPFront
 
 _log = get_logger("serve.router")
@@ -156,6 +186,12 @@ class FleetRouter:
         breaker_cooldown_s: float | None = None,
         drain_timeout_s: float | None = None,
         request_timeout_s: float = 60.0,
+        deadline_floor_ms: float | None = None,
+        retry_budget: RetryBudget | None = None,
+        hedge_enable: bool | None = None,
+        hedge_quantile: float | None = None,
+        hedge_min_ms: float | None = None,
+        quarantine: QuarantineTable | None = None,
         name: str = "fleet",
     ):
         self.name = name
@@ -180,6 +216,31 @@ class FleetRouter:
             "fleet_breaker_cooldown_s", breaker_cooldown_s
         ))
         self._request_timeout_s = float(request_timeout_s)
+        # Storm defense (docs/RESILIENCE.md §7): deadline floor, shared
+        # retry budget, hedging, and the query-of-death table. Defaults
+        # resolve through the audited knob table; pass explicit instances
+        # (or RetryBudget(fraction=0.0)) to share or disable.
+        self.deadline_floor_ms = float(exec_config.resolve(
+            "fleet_deadline_floor_ms", deadline_floor_ms
+        ))
+        self.retry_budget = (
+            RetryBudget(name=name) if retry_budget is None else retry_budget
+        )
+        self.hedge_enable = bool(exec_config.resolve(
+            "hedge_enable", hedge_enable
+        ))
+        self.hedge_quantile = float(exec_config.resolve(
+            "hedge_quantile", hedge_quantile
+        ))
+        self.hedge_min_ms = float(exec_config.resolve(
+            "hedge_min_ms", hedge_min_ms
+        ))
+        self.quarantine = (
+            QuarantineTable(name=name) if quarantine is None else quarantine
+        )
+        # Recent *successful* dispatch latencies: the hedge timer's p9x
+        # source (failures are usually fast and would shrink the delay).
+        self._lat: deque[float] = deque(maxlen=256)
         self._lock = threading.Lock()
         self._pin: str | None = None
         self._handles: list[ReplicaHandle] = []
@@ -460,6 +521,200 @@ class FleetRouter:
             ejected=ejected,
         )
 
+    # ----------------------------------------------------- attempt/hedge ---
+    def _call_one(
+        self, h: ReplicaHandle, texts: list, *, rows: int, attempt: int,
+        hedge: bool, want_labels: bool, segment_kw: dict | None,
+        priority: str, deadline_ms: float | None, trace_id: str,
+        tenant: str | None,
+    ):
+        """One wire dispatch to one replica. Releases its reservation and
+        counts ``fleet/dispatches`` whatever happens; only successes feed
+        the hedge timer's latency history."""
+        t0 = time.perf_counter()
+        try:
+            with span(
+                "fleet/dispatch", replica=h.name, rows=rows,
+                attempt=attempt,
+            ):
+                if hedge:
+                    faults.inject("fleet/hedge")
+                else:
+                    faults.inject("fleet/dispatch")
+                # The tenant rides the request to whichever replica
+                # wins: every replica fronts the same zoo surface, so
+                # tenant routing is the replica's (SERVING.md §12) —
+                # the fleet tier only has to carry the name.
+                if segment_kw is not None:
+                    out, meta = h.client.segment(
+                        texts, priority=priority,
+                        deadline_ms=deadline_ms, trace_id=trace_id,
+                        tenant=tenant, **segment_kw,
+                    )
+                elif want_labels:
+                    out, meta = h.client.detect(
+                        texts, priority=priority,
+                        deadline_ms=deadline_ms, trace_id=trace_id,
+                        tenant=tenant,
+                    )
+                else:
+                    out, meta = h.client.score(
+                        texts, priority=priority,
+                        deadline_ms=deadline_ms, trace_id=trace_id,
+                        tenant=tenant,
+                    )
+            with self._lock:
+                self._lat.append(time.perf_counter() - t0)
+            return out, meta
+        finally:
+            REGISTRY.incr("fleet/dispatches")
+            self._release(h, rows)
+
+    def _hedge_delay_s(self) -> float:
+        """Hedge-arm delay: the observed dispatch-latency quantile,
+        floored by ``hedge_min_ms`` (which also covers cold history)."""
+        floor = self.hedge_min_ms / 1e3
+        with self._lock:
+            lat = sorted(self._lat)
+        if len(lat) < 8:
+            return floor
+        q = min(max(self.hedge_quantile, 0.0), 1.0)
+        return max(floor, lat[min(len(lat) - 1, int(q * len(lat)))])
+
+    def _note_side_failure(
+        self, h: ReplicaHandle, exc: Exception, excluded: set,
+        saturated: list, sig: str, texts: list,
+    ) -> None:
+        """Failure bookkeeping for a hedge leg that no longer decides the
+        request (the other leg won or will): same breaker/exclusion/
+        quarantine effects as the main loop, but never raises."""
+        if isinstance(exc, ServeHTTPError):
+            if exc.status == 503 and exc.shed:
+                saturated.append(exc.retry_after_s)
+                excluded.add(h.name)
+                REGISTRY.incr("fleet/replica_saturated")
+            elif exc.status == 503 or (
+                exc.status >= 500 and exc.status != 504
+            ):
+                excluded.add(h.name)
+                self._note_dispatch_failure(h, exc)
+            # 400/404/504: the replica answered; nothing to eject.
+            return
+        if isinstance(exc, HTTPException) or is_retryable(exc):
+            excluded.add(h.name)
+            self._note_dispatch_failure(h, exc)
+            self.quarantine.record_death(
+                sig, replica=h.name, source="router", texts=texts
+            )
+
+    def _attempt(
+        self, h: ReplicaHandle, texts: list, *, rows: int, attempt: int,
+        excluded: set, saturated: list, sig: str, **call_kw,
+    ):
+        """One dispatch attempt, hedged when enabled: the primary runs in
+        a worker; if it has not answered within the p9x delay AND a
+        distinct replica AND a budget token exist, one hedge races it and
+        the first answer wins. Sound because scoring is a pure read and
+        the version pin holds for both legs. Returns
+        ``(out, meta, served_by)``; raises the *primary's* error when no
+        leg succeeds (the hedge leg's failure is bookkeeping only)."""
+        if not self.hedge_enable:
+            out, meta = self._call_one(
+                h, texts, rows=rows, attempt=attempt, hedge=False,
+                **call_kw,
+            )
+            return out, meta, h.name
+        results: queue.SimpleQueue = queue.SimpleQueue()
+
+        def run(handle: ReplicaHandle, is_hedge: bool) -> None:
+            try:
+                out, meta = self._call_one(
+                    handle, texts, rows=rows, attempt=attempt,
+                    hedge=is_hedge, **call_kw,
+                )
+                results.put(("ok", handle, is_hedge, out, meta))
+            except BaseException as e:
+                results.put(("err", handle, is_hedge, e))
+
+        threading.Thread(
+            target=run, args=(h, False),
+            name=f"{self.name}-dispatch-{h.name}", daemon=True,
+        ).start()
+        first = None
+        try:
+            first = results.get(timeout=self._hedge_delay_s())
+        except queue.Empty:
+            pass
+        pending = 1
+        if first is None:
+            # Primary is straggling: arm the hedge — replica first (no
+            # token burned when the fleet has no second replica to try),
+            # then the budget (hedges self-disable under overload).
+            h2 = self._pick(rows, excluded | {h.name})
+            if h2 is not None and not self.retry_budget.try_spend(
+                reason="hedge"
+            ):
+                self._release(h2, rows)
+                h2 = None
+            if h2 is not None:
+                REGISTRY.incr("fleet/hedges")
+                log_event(
+                    _log, "fleet.hedge", primary=h.name, hedge=h2.name,
+                    rows=rows, attempt=attempt,
+                )
+                threading.Thread(
+                    target=run, args=(h2, True),
+                    name=f"{self.name}-hedge-{h2.name}", daemon=True,
+                ).start()
+                pending += 1
+        primary_exc: Exception | None = None
+        while pending:
+            item = first if first is not None else results.get()
+            first = None
+            pending -= 1
+            if item[0] == "ok":
+                _, handle, is_hedge, out, meta = item
+                if is_hedge:
+                    REGISTRY.incr("fleet/hedge_wins")
+                if pending:
+                    # The loser finishes in the background; its failure
+                    # (a crash under a query of death!) must still feed
+                    # the breaker/quarantine bookkeeping.
+                    self._absorb_loser(
+                        results, excluded, saturated, sig, texts
+                    )
+                return out, meta, handle.name
+            _, handle, is_hedge, exc = item
+            if not isinstance(exc, Exception):
+                raise exc  # KeyboardInterrupt/SystemExit: never classified
+            if is_hedge:
+                self._note_side_failure(
+                    handle, exc, excluded, saturated, sig, texts
+                )
+            else:
+                primary_exc = exc
+        if primary_exc is None:  # unreachable: primary always reports
+            raise RuntimeError("hedged dispatch lost its primary result")
+        raise primary_exc
+
+    def _absorb_loser(
+        self, results: queue.SimpleQueue, excluded: set, saturated: list,
+        sig: str, texts: list,
+    ) -> None:
+        def absorb() -> None:
+            try:
+                item = results.get(timeout=self._request_timeout_s + 5.0)
+            except Exception:
+                return
+            if item[0] == "err" and isinstance(item[3], Exception):
+                self._note_side_failure(
+                    item[1], item[3], excluded, saturated, sig, texts
+                )
+
+        threading.Thread(
+            target=absorb, name=f"{self.name}-hedge-absorb", daemon=True,
+        ).start()
+
     def score(self, texts, **kw):
         """(float32 [N, L] scores, response metadata incl. ``replica``)."""
         return self._dispatch(list(texts), want_labels=False, **kw)
@@ -526,41 +781,66 @@ class FleetRouter:
         trace_id: str,
         tenant: str | None,
     ):
+        # Absolute deadline, stamped once: failover attempts decay the
+        # *remaining* budget, never re-spend the original.
+        deadline_at = (
+            None if deadline_ms is None
+            else t0 + float(deadline_ms) / 1e3
+        )
+        # Hashing every request buys nothing when the table is off
+        # (kill drills, quarantine_deaths<=0): empty sig short-circuits
+        # every quarantine call below.
+        sig = signature_of(texts) if self.quarantine.enabled else ""
+        if sig and self.quarantine.check(sig):
+            REGISTRY.incr("fleet/quarantine_rejects")
+            log_event(
+                _log, "fleet.quarantine_reject", signature=sig,
+                rows=rows, trace_id=trace_id,
+            )
+            raise QueryQuarantined(sig, self.quarantine.deaths_threshold)
         while attempt < self.dispatch_attempts:
+            attempt_deadline_ms = None
+            if deadline_at is not None:
+                attempt_deadline_ms = (
+                    (deadline_at - time.perf_counter()) * 1e3
+                )
+                if attempt_deadline_ms < self.deadline_floor_ms:
+                    # Below the floor the answer would be dead on
+                    # arrival: 504 here, never burn another replica.
+                    REGISTRY.incr("fleet/deadline_rejects")
+                    raise ServeDeadlineExceeded(
+                        f"remaining deadline "
+                        f"{max(attempt_deadline_ms, 0.0):.1f}ms is below "
+                        f"the {self.deadline_floor_ms:g}ms dispatch floor "
+                        f"after {attempt} attempt(s)"
+                    )
+            # Every attempt past the first is a retry: it must withdraw
+            # from the shared budget, so an outage degrades to bounded
+            # goodput loss instead of a retry storm.
+            if attempt > 0 and not self.retry_budget.try_spend(
+                reason="failover"
+            ):
+                REGISTRY.incr("fleet/shed_requests")
+                raise FleetSaturated(
+                    f"retry budget exhausted after {attempt} attempt(s) "
+                    f"({self.retry_budget.describe()['tokens']} tokens)",
+                    reason="retry_budget_exhausted",
+                    retry_after_s=max(self.probe_interval_s, 0.05),
+                )
             h = self._pick(rows, excluded)
             if h is None:
                 break
             attempt += 1
+            self.quarantine.note_dispatch(h.name, sig, texts)
             try:
-                with span(
-                    "fleet/dispatch", replica=h.name, rows=rows,
-                    attempt=attempt,
-                ):
-                    faults.inject("fleet/dispatch")
-                    # The tenant rides the request to whichever replica
-                    # wins: every replica fronts the same zoo surface, so
-                    # tenant routing is the replica's (SERVING.md §12) —
-                    # the fleet tier only has to carry the name.
-                    if segment_kw is not None:
-                        out, meta = h.client.segment(
-                            texts, priority=priority,
-                            deadline_ms=deadline_ms, trace_id=trace_id,
-                            tenant=tenant, **segment_kw,
-                        )
-                    elif want_labels:
-                        out, meta = h.client.detect(
-                            texts, priority=priority,
-                            deadline_ms=deadline_ms, trace_id=trace_id,
-                            tenant=tenant,
-                        )
-                    else:
-                        out, meta = h.client.score(
-                            texts, priority=priority,
-                            deadline_ms=deadline_ms, trace_id=trace_id,
-                            tenant=tenant,
-                        )
+                out, meta, served_by = self._attempt(
+                    h, texts, rows=rows, attempt=attempt,
+                    excluded=excluded, saturated=saturated, sig=sig,
+                    want_labels=want_labels, segment_kw=segment_kw,
+                    priority=priority, deadline_ms=attempt_deadline_ms,
+                    trace_id=trace_id, tenant=tenant,
+                )
             except ServeHTTPError as e:
-                self._release(h, rows)
                 if e.status == 503 and e.shed:
                     # Healthy but saturated: not a failure, but this
                     # request must try the rest of the fleet.
@@ -580,17 +860,22 @@ class FleetRouter:
                 # dead-on-arrival work and mis-feed their breakers).
                 raise
             except Exception as e:
-                self._release(h, rows)
                 if not (isinstance(e, HTTPException) or is_retryable(e)):
                     raise
                 excluded.add(h.name)
                 self._note_dispatch_failure(h, e)
+                # A connection severed mid-flight is a dispatch that
+                # coincided with replica death: charge this request's
+                # signature in the query-of-death table.
+                self.quarantine.record_death(
+                    sig, replica=h.name, source="router", texts=texts
+                )
                 continue
-            self._release(h, rows)
+            self.retry_budget.record_success()
             REGISTRY.incr("fleet/requests")
             REGISTRY.observe("fleet/request_s", time.perf_counter() - t0)
             REGISTRY.observe("fleet/attempts_per_request", attempt)
-            meta["replica"] = h.name
+            meta["replica"] = served_by
             return out, meta
         # Exhausted. Every eligible replica either shed (saturated) or
         # died under this request (excluded) — an explicit, retryable
@@ -681,6 +966,18 @@ class FleetRouter:
             "pinned_version": pin,
             "replicas": replicas,
             "uptime_s": round(time.monotonic() - self._started, 3),
+            # Storm-defense state (docs/RESILIENCE.md §7): the budget's
+            # live token balance and the query-of-death table, so /varz
+            # shows WHY the fleet is shedding retries or 422ing a
+            # signature.
+            "retry_budget": self.retry_budget.describe(),
+            "quarantine": self.quarantine.describe(),
+            "hedging": {
+                "enabled": self.hedge_enable,
+                "quantile": self.hedge_quantile,
+                "min_ms": self.hedge_min_ms,
+                "delay_ms": round(self._hedge_delay_s() * 1e3, 3),
+            },
         }
 
     def readyz(self) -> dict:

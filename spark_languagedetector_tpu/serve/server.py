@@ -56,6 +56,7 @@ from .batcher import (
     ServeOverloaded,
 )
 from .client import ServeHTTPError
+from .quarantine import QueryQuarantined
 from .registry import ModelRegistry
 
 _log = get_logger("serve.server")
@@ -177,6 +178,16 @@ class _Handler(BaseHTTPRequestHandler):
                 if k.lower() == "retry-after":
                     headers["Retry-After"] = v
             self._reply(e.status, payload, headers)
+        except QueryQuarantined as e:
+            # Query of death (docs/RESILIENCE.md §7): a well-formed
+            # request the fleet refuses to re-serve — 422, with the
+            # signature so the caller can find it in the serve DLQ.
+            # Before ValueError: QueryQuarantined subclasses it.
+            self._reply(422, {
+                "error": str(e),
+                "quarantined": True,
+                "signature": e.signature,
+            })
         except (ValueError, KeyError) as e:
             self._reply(400, {"error": repr(e)})
         except Exception as e:

@@ -103,6 +103,19 @@ _RELIABILITY_COUNTERS = (
     # flying partially blind — the aggregate (and everything reading it:
     # autoscaler pressure, SLO burn rates) silently under-counts.
     "fleet/agg_scrape_failures",
+    # Storm defense (docs/RESILIENCE.md §7). Each of these appearing
+    # against a clean baseline is a reliability event on a fixed
+    # workload: the retry budget draining means retries outran the
+    # success fraction (an outage or a retry-amplification bug), a
+    # router-side deadline reject means requests arrived at the fleet
+    # tier with no budget left, and a quarantine firing means requests
+    # started killing replicas. The protective response working is
+    # exactly the signal the guard must surface.
+    "fleet/retry_budget_exhausted",
+    "fleet/deadline_rejects",
+    "fleet/quarantined_signatures",
+    "fleet/quarantine_rejects",
+    "serve/client_deadline_gaveups",
 )
 
 # Informational counters: diffed and shown like the reliability set but
@@ -125,6 +138,13 @@ _INFORMATIONAL_COUNTERS = (
     # fleet/agg_scrape_failures and the slo/burn_rate histogram instead.
     "fleet/agg_scrapes",
     "slo/alerts",
+    # Storm-defense volume: wire dispatches (the retry-amplification
+    # denominator's partner) and hedges firing/winning are the hedging
+    # plane doing its latency job when enabled — the regression gates
+    # live on fleet/retry_budget_exhausted and the latency histograms.
+    "fleet/dispatches",
+    "fleet/hedges",
+    "fleet/hedge_wins",
 )
 
 _TRACKED_RATIOS = {
@@ -278,7 +298,7 @@ def capture_stats(events: list[dict]) -> dict:
         # failovers/ejections/swap aborts): a regression here is a
         # reliability story even when every latency percentile held
         # steady, so the guard diffs them like any other metric
-        # (docs/RESILIENCE.md §7, docs/SERVING.md §6, §9).
+        # (docs/RESILIENCE.md §8, docs/SERVING.md §6, §9).
         cpayload = ev.get("counters")
         if isinstance(cpayload, dict):
             counters = {

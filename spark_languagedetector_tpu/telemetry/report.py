@@ -185,7 +185,7 @@ def render_report(events: list[dict]) -> str:
         # counters and gauges pulled out of the generic sections, so a
         # chaos run's (or an incident's) capture answers "did we degrade,
         # how often did we retry, what got quarantined" at a glance
-        # (docs/RESILIENCE.md §7).
+        # (docs/RESILIENCE.md §8).
         res = _resilience_summary(counters, gauges)
         if res:
             lines.append("")

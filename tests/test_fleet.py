@@ -27,6 +27,7 @@ from spark_languagedetector_tpu.serve import ContinuousBatcher, ModelRegistry
 from spark_languagedetector_tpu.serve.batcher import ServeOverloaded
 from spark_languagedetector_tpu.serve.client import ServeClient, ServeHTTPError
 from spark_languagedetector_tpu.serve.fleet import ServeFleet
+from spark_languagedetector_tpu.serve.quarantine import QuarantineTable
 from spark_languagedetector_tpu.serve.router import (
     FleetSaturated,
     FleetSwapError,
@@ -61,6 +62,11 @@ def _models(seed, n=3):
 ROUTER_KW = dict(
     probe_interval_ms=30.0, probe_timeout_s=2.0, dispatch_attempts=3,
     breaker_threshold=2, breaker_cooldown_s=0.15, drain_timeout_s=5.0,
+    # These drills kill replicas under the same three TEXTS over and
+    # over; an active quarantine table would (correctly) flag them as
+    # queries of death and 422 the failover behavior being pinned.
+    # tests/test_storm.py drills quarantine with its own tables.
+    quarantine=QuarantineTable(0, name="fleet-test-off"),
 )
 
 

@@ -1,0 +1,470 @@
+"""Storm defense: deadline decay, retry budgets, hedging, quarantine.
+
+The acceptance contract (ISSUE 18): a request's deadline budget *decays*
+into every failover attempt (the old bug re-sent the original verbatim,
+so attempt N promised time the client no longer had), dispatch is
+refused outright below the deadline floor, client retry sleeps never
+outlive the request's own deadline, every extra attempt — router
+failover, client re-send, hedge — withdraws from a shared token-bucket
+:class:`RetryBudget` whose exhaustion is an explicit shed, a hedged
+dispatch races one speculative send against a straggling primary with
+the first answer winning, and a query of death is quarantined (422 +
+serve DLQ) after at most K correlated replica deaths. The ``fleet/hedge``
+and ``fleet/quarantine`` chaos sites replay deterministically — same
+plan + seed, same outcome sequence — and the quarantine table degrades
+*open* under injected faults.
+"""
+
+import functools
+import time
+
+import numpy as np
+import pytest
+
+from spark_languagedetector_tpu import LanguageDetectorModel
+from spark_languagedetector_tpu.ops.encoding import texts_to_bytes
+from spark_languagedetector_tpu.resilience import faults
+from spark_languagedetector_tpu.resilience.faults import FaultPlan
+from spark_languagedetector_tpu.resilience.policy import RetryBudget, RetryPolicy
+from spark_languagedetector_tpu.serve.batcher import ServeDeadlineExceeded
+from spark_languagedetector_tpu.serve.client import ServeClient, ServeHTTPError
+from spark_languagedetector_tpu.serve.fleet import ServeFleet
+from spark_languagedetector_tpu.serve.quarantine import (
+    QuarantineTable,
+    QueryQuarantined,
+    signature_of,
+)
+from spark_languagedetector_tpu.serve.router import FleetRouter, FleetSaturated
+from spark_languagedetector_tpu.telemetry import REGISTRY
+
+LANGS = ("x", "y")
+GRAM_KEYS = (b"ab", b"bc", b"zz", b"abc")
+TEXTS = ["abab", "zz", "abczz"]
+
+
+@functools.lru_cache(maxsize=None)
+def _model(seed=0):
+    rng = np.random.default_rng(seed)
+    gram_map = {g: rng.normal(size=2).tolist() for g in GRAM_KEYS}
+    return LanguageDetectorModel.from_gram_map(gram_map, (2, 3), LANGS)
+
+
+def _counter(name):
+    return int(REGISTRY.snapshot()["counters"].get(name, 0))
+
+
+# ------------------------------------------------------- retry budget -------
+def test_retry_budget_token_bucket_semantics():
+    """Burst is the starting balance, each spend withdraws one whole
+    token, each success deposits ``fraction`` capped at burst."""
+    b = RetryBudget(0.5, 2.0, name="t")
+    assert b.enabled
+    assert b.try_spend() and b.try_spend()
+    assert not b.try_spend()  # drained: the bucket never goes negative
+    b.record_success()
+    assert not b.try_spend()  # 0.5 tokens: a retry costs a WHOLE token
+    b.record_success()
+    assert b.try_spend()
+    d = b.describe()
+    assert d["successes"] == 2 and d["spent"] == 3 and d["denied"] == 2
+    for _ in range(100):
+        b.record_success()
+    assert b.describe()["tokens"] == 2.0  # capped at burst
+
+
+def test_retry_budget_fraction_zero_disables():
+    b = RetryBudget(0.0, 5.0, name="off")
+    assert not b.enabled
+    for _ in range(50):
+        assert b.try_spend()  # disabled: never denies, never counts
+
+
+def test_retry_budget_exhaustion_counts_and_recovers():
+    REGISTRY.reset()
+    b = RetryBudget(1.0, 1.0, name="tiny")
+    assert b.try_spend()
+    base = _counter("fleet/retry_budget_exhausted")
+    assert not b.try_spend()
+    assert _counter("fleet/retry_budget_exhausted") == base + 1
+    b.record_success()  # fraction 1.0: one success refills one retry
+    assert b.try_spend()
+
+
+# ------------------------------------------- router over fake replicas ------
+class _FakeReplicaClient:
+    """Stands in for a handle's ServeClient: records each dispatch's
+    deadline_ms and either answers or dies like a severed connection."""
+
+    def __init__(self, name, *, fail_first=0, sleep_s=0.0):
+        self.name = name
+        self.deadlines = []
+        self.calls = 0
+        self.fail_first = fail_first
+        self.sleep_s = sleep_s
+
+    def detect(self, texts, *, priority=None, deadline_ms=None,
+               trace_id=None, tenant=None):
+        self.calls += 1
+        self.deadlines.append(deadline_ms)
+        if self.sleep_s:
+            time.sleep(self.sleep_s)
+        if self.calls <= self.fail_first:
+            raise ConnectionResetError(f"{self.name} died mid-flight")
+        return ["x"] * len(texts), {"version": "v1"}
+
+    score = segment = detect
+
+
+def _fake_router(fakes, **router_kw):
+    """A FleetRouter whose handles talk to in-memory fakes: no sockets,
+    no probes — the failover/deadline/budget logic under test, alone."""
+    router_kw.setdefault("breaker_threshold", 99)
+    router_kw.setdefault("dispatch_attempts", 3)
+    router = FleetRouter(
+        [("127.0.0.1", 1 + i) for i in range(len(fakes))], **router_kw
+    )
+    for h, fake in zip(router._handles, fakes):
+        h.client = fake
+        h.ready = True
+        h.reasons = []
+    return router
+
+
+def test_failover_decays_remaining_deadline_not_original():
+    """THE deadline re-send regression (ISSUE 18 satellite): each
+    failover attempt must carry the *remaining* budget. Two replicas
+    each burn ~30ms dying; the deadlines recorded downstream must be
+    strictly decreasing by at least that burn, never the original."""
+    REGISTRY.reset()
+    fakes = [
+        _FakeReplicaClient("r0", fail_first=9, sleep_s=0.03),
+        _FakeReplicaClient("r1", fail_first=9, sleep_s=0.03),
+        _FakeReplicaClient("r2"),
+    ]
+    router = _fake_router(fakes)
+    labels, meta = router.detect(TEXTS, deadline_ms=500.0)
+    assert labels == ["x"] * 3 and meta["replica"] == "r2"
+    seen = [f.deadlines[0] for f in fakes]
+    assert seen[0] < 500.0  # even attempt 1 carries elapsed admission time
+    assert seen[1] <= seen[0] - 25.0  # r0 burned ~30ms before dying
+    assert seen[2] <= seen[1] - 25.0
+    assert all(d > 0 for d in seen)
+
+
+def test_dispatch_refused_below_deadline_floor():
+    """A remaining budget under the floor is a 504 *before* any replica
+    is burned — no fake must ever see the request."""
+    REGISTRY.reset()
+    fakes = [_FakeReplicaClient("r0")]
+    router = _fake_router(fakes, deadline_floor_ms=50.0)
+    with pytest.raises(ServeDeadlineExceeded):
+        router.detect(TEXTS, deadline_ms=40.0)
+    assert fakes[0].calls == 0
+    assert _counter("fleet/deadline_rejects") == 1
+
+
+def test_router_failovers_draw_from_retry_budget():
+    """Attempt 1 is free; every later attempt withdraws a token. With
+    burst=1 the second failover is denied: an explicit budget shed."""
+    REGISTRY.reset()
+    fakes = [_FakeReplicaClient(f"r{i}", fail_first=9) for i in range(3)]
+    router = _fake_router(
+        fakes, retry_budget=RetryBudget(0.1, 1.0, name="t")
+    )
+    with pytest.raises(FleetSaturated) as exc:
+        router.detect(TEXTS)
+    assert exc.value.reason == "retry_budget_exhausted"
+    assert exc.value.retry_after_s > 0
+    # r0 died free, r1 cost the only token, r2 was never tried.
+    assert fakes[0].calls == 1 and fakes[1].calls == 1
+    assert fakes[2].calls == 0
+    assert _counter("fleet/retry_budget_exhausted") == 1
+    assert _counter("fleet/shed_requests") == 1
+
+
+def test_router_quarantines_query_of_death_after_k_deaths():
+    """K=2 correlated deaths quarantine the signature: the next send is
+    refused before any dispatch, with the request in the serve DLQ."""
+    REGISTRY.reset()
+    table = QuarantineTable(2, name="t")
+    fakes = [_FakeReplicaClient("r0", fail_first=2)] + [
+        _FakeReplicaClient(f"r{i}") for i in (1, 2)
+    ]
+    router = _fake_router(fakes, quarantine=table)
+    for _ in range(2):  # each request: r0 dies on it, failover answers
+        labels, _meta = router.detect(TEXTS)
+        assert labels == ["x"] * 3
+    assert table.describe()["quarantined"] == [signature_of(TEXTS)]
+    with pytest.raises(QueryQuarantined) as exc:
+        router.detect(TEXTS)
+    assert exc.value.signature == signature_of(TEXTS)
+    assert sum(f.calls for f in fakes) == 4  # the 422 burned no replica
+    assert _counter("fleet/quarantine_rejects") == 1
+    assert _counter("fleet/quarantined_signatures") == 1
+    # Different content keeps flowing: the table keys on signatures.
+    labels, _meta = router.detect(["zz"])
+    assert labels == ["x"]
+
+
+# ------------------------------------------------------- quarantine table ---
+def test_quarantine_table_thresholds_dlq_and_lru(tmp_path):
+    dlq_path = str(tmp_path / "dlq.jsonl")
+    t = QuarantineTable(2, 2, dlq_path=dlq_path, name="t")
+    sig = signature_of(["boom"])
+    assert not t.record_death(sig, replica="r0", texts=["boom"])
+    assert not t.check(sig)
+    assert t.record_death(sig, replica="r1", texts=["boom"])
+    assert t.check(sig)
+    rows = t.dlq.records
+    assert len(rows) == 1 and rows[0]["row"]["signature"] == sig
+    assert rows[0]["row"]["replicas"] == ["router:r0", "router:r1"]
+    assert rows[0]["error"] == "query_of_death"
+    # Suspect map is LRU-bounded at max_entries=2.
+    for i in range(4):
+        t.record_death(f"sig{i}")
+    assert t.describe()["suspects"] == 2
+
+
+def test_supervisor_death_report_charges_last_signature():
+    """The out-of-band path (scale/elastic feeds this): a supervisor
+    noticing a replica die charges whatever was last routed there —
+    once per dispatch, so the router's own mid-flight charge and the
+    supervisor's report can't double-count a single death event."""
+    t = QuarantineTable(2, name="t")
+    sig = signature_of(["killer"])
+    t.note_dispatch("r7", sig, ["killer"])
+    assert not t.replica_died("r7")
+    # Same death event reported again (router already charged it): the
+    # pending signature was consumed, nothing further to charge.
+    assert not t.replica_died("r7")
+    assert t.describe()["suspects"] == 1 and not t.check(sig)
+    # The replica restarts, serves the query again, dies again: that IS
+    # a second correlated death.
+    t.note_dispatch("r7", sig, ["killer"])
+    assert t.replica_died("r7", source="supervisor")
+    assert t.check(sig)
+    assert not t.replica_died("r8")  # nothing ever routed there
+
+
+def test_quarantine_deaths_zero_disables():
+    """deaths<=0 turns the table off (mirrors RetryBudget fraction=0):
+    the opt-out for drills that kill replicas under benign traffic."""
+    t = QuarantineTable(0, name="off")
+    assert not t.enabled
+    sig = signature_of(["boom"])
+    for _ in range(5):
+        assert not t.record_death(sig, replica="r0")
+    assert not t.check(sig)
+    assert t.describe()["suspects"] == 0
+
+
+def test_signature_is_order_sensitive_and_stable():
+    assert signature_of(["a", "b"]) != signature_of(["b", "a"])
+    assert signature_of(["a", "b"]) == signature_of(["a", "b"])
+    assert len(signature_of([])) == 16
+
+
+# ------------------------------------------------------- client deadline ----
+class _Always503Client(ServeClient):
+    def __init__(self, *, retry_after_s, **kw):
+        super().__init__("127.0.0.1", 1, **kw)
+        self.attempts = 0
+        self._retry_after_s = retry_after_s
+
+    def _request_once(self, method, path, payload=None):
+        self.attempts += 1
+        raise ServeHTTPError(
+            503, {"error": "shed", "shed": True},
+            {"Retry-After": str(self._retry_after_s)},
+        )
+
+
+def test_client_retry_sleep_never_outlives_deadline():
+    """The retry-sleep regression (ISSUE 18 satellite): a 30s Retry-After
+    against a 150ms deadline must surface the error immediately instead
+    of sleeping into a dead response."""
+    REGISTRY.reset()
+    client = _Always503Client(
+        retry_after_s=30.0,
+        retry_policy=RetryPolicy(
+            max_attempts=6, base_delay_s=0.01, max_delay_s=0.05, seed=1
+        ),
+    )
+    t0 = time.monotonic()
+    with pytest.raises(ServeHTTPError):
+        client.detect(["a"], deadline_ms=150.0)
+    assert time.monotonic() - t0 < 2.0  # not the 30s the server asked for
+    assert client.attempts == 1
+    assert _counter("serve/client_deadline_gaveups") == 1
+
+
+def test_client_without_deadline_still_retries():
+    client = _Always503Client(
+        retry_after_s=0.0,
+        retry_policy=RetryPolicy(
+            max_attempts=3, base_delay_s=0.001, max_delay_s=0.002, seed=1
+        ),
+    )
+    with pytest.raises(ServeHTTPError):
+        client.detect(["a"])
+    assert client.attempts == 3
+
+
+def test_client_retries_draw_from_budget():
+    """A drained budget turns the client's own retry loop off: the herd
+    cannot amplify an outage beyond its successful-traffic fraction."""
+    budget = RetryBudget(0.1, 1.0, name="t")
+    assert budget.try_spend()  # drain
+    client = _Always503Client(
+        retry_after_s=0.0,
+        retry_policy=RetryPolicy(
+            max_attempts=5, base_delay_s=0.001, max_delay_s=0.002, seed=1
+        ),
+        retry_budget=budget,
+    )
+    with pytest.raises(ServeHTTPError):
+        client.detect(["a"])
+    assert client.attempts == 1  # denied before the first re-send
+
+
+# ------------------------------------------------- chaos replay: hedge ------
+ROUTER_KW = dict(
+    probe_interval_ms=30.0, probe_timeout_s=2.0, dispatch_attempts=3,
+    breaker_threshold=5, breaker_cooldown_s=30.0, drain_timeout_s=5.0,
+    hedge_enable=True, hedge_min_ms=30.0,
+)
+
+
+def _hedge_sequence(plan):
+    """4 hedged requests under ``plan`` on a fresh fleet; returns the
+    per-request (labels-right, hedges, wins, failovers) tuples."""
+    fl = ServeFleet(
+        [_model(1)] * 3,
+        router_kw={
+            **ROUTER_KW,
+            "retry_budget": RetryBudget(1.0, 10.0, name="hedge-test"),
+        },
+        max_wait_ms=2, max_rows=64,
+    )
+    fl.start(probe=False)
+    fl.router.probe_once()  # deterministic readiness, no probe thread
+    try:
+        runner = fl.replicas[0].registry.peek().runner
+        want = [
+            LANGS[int(i)] for i in runner.predict_ids(texts_to_bytes(TEXTS))
+        ]
+        out = []
+        with faults.plan_scope(FaultPlan.parse(plan)):
+            for _ in range(4):
+                labels, _meta = fl.router.detect(TEXTS)
+                out.append((
+                    labels == want,
+                    _counter("fleet/hedges"),
+                    _counter("fleet/hedge_wins"),
+                    _counter("fleet/failovers"),
+                ))
+        return out
+    finally:
+        fl.close()
+
+
+def test_chaos_hedge_prob_replays_deterministically():
+    """%prob stragglers on fleet/dispatch: the same plan + seed produces
+    the identical hedge/win sequence on a fresh fleet, every answer
+    stays right, and the injected tail demonstrably arms hedges."""
+    REGISTRY.reset()
+    a = _hedge_sequence("seed=7;fleet/dispatch:delay=0.08%0.5")
+    REGISTRY.reset()
+    b = _hedge_sequence("seed=7;fleet/dispatch:delay=0.08%0.5")
+    assert a == b
+    assert all(right for right, *_ in a)
+    assert a[-1][1] >= 1  # at least one straggler armed a hedge
+    assert a[-1][2] >= 1  # ...and the hedge answered first
+
+
+def test_chaos_hedge_error_kills_hedge_not_answer():
+    """An @calls error at fleet/hedge kills that hedge attempt only: the
+    straggling primary still answers, the loser's failure feeds the
+    failover bookkeeping, and the schedule replays exactly."""
+    plan = "seed=3;fleet/dispatch:delay=0.08@1;fleet/hedge:error@1"
+    REGISTRY.reset()
+    a = _hedge_sequence(plan)
+    REGISTRY.reset()
+    b = _hedge_sequence(plan)
+    assert a == b
+    assert all(right for right, *_ in a)
+    # Request 1: primary straggles, the hedge is armed and injected dead
+    # — the primary's (delayed) answer still serves the request.
+    assert a[0][1] == 1 and a[0][2] == 0
+    assert a[0][3] == 1  # the dead hedge counted as a failover
+    # No further stragglers: no further hedges.
+    assert a[-1][1] == 1
+
+
+# -------------------------------------------- chaos replay: quarantine ------
+def _quarantine_sequence(plan):
+    """12 death records over 4 signatures under ``plan``; returns the
+    (crossed-threshold, suspects, quarantined) tuple per op."""
+    t = QuarantineTable(3, name="t")
+    out = []
+    with faults.plan_scope(FaultPlan.parse(plan)):
+        for i in range(12):
+            newly = t.record_death(f"sig{i % 4}")
+            d = t.describe()
+            out.append((newly, d["suspects"], len(d["quarantined"])))
+    return out
+
+
+def test_chaos_quarantine_prob_replays_deterministically():
+    """%prob faults at fleet/quarantine drop death observations — the
+    table degrades OPEN (protection delayed, nothing rejected) and the
+    dropped-op schedule replays exactly per seed."""
+    plan = "seed=9;fleet/quarantine:error%0.4"
+    a = _quarantine_sequence(plan)
+    b = _quarantine_sequence(plan)
+    assert a == b
+    clean = _quarantine_sequence("seed=9")
+    # The faulted run dropped observations: strictly behind the clean run.
+    assert a[-1][2] < clean[-1][2]
+
+
+def test_chaos_quarantine_check_degrades_open():
+    """An injected fault on the lookup admits the request (answers "not
+    quarantined") rather than rejecting healthy traffic — and the next
+    clean lookup enforces again."""
+    t = QuarantineTable(1, name="t")
+    sig = signature_of(["boom"])
+    t.record_death(sig)
+    assert t.check(sig)
+    with faults.plan_scope(FaultPlan.parse("seed=1;fleet/quarantine:error@1")):
+        assert not t.check(sig)  # degraded open
+        assert t.check(sig)      # @1 exhausted: enforcement resumes
+
+
+# ------------------------------------------------------- bench smoke gate ---
+def test_bench_smoke_storm_trimmed(tmp_path):
+    """Tier-1-sized storm smoke: poison quarantine, budget-bounded
+    outage, hedged straggler rescue, overload self-disable — hard-gated
+    exactly like the CI gate."""
+    import bench
+
+    result = bench.smoke_storm(str(tmp_path / "storm.jsonl"), trimmed=True)
+    assert result["ok"], result["errors"] or result
+    assert result["argmax_parity"] == 1.0
+    assert result["poison"]["status"] == 422
+    assert result["outage"]["amplification"] <= result["outage"][
+        "amplification_bound"
+    ]
+    assert result["overload"]["hedges"] == 0
+    assert min(result["survival_checks"]) >= 1
+
+
+@pytest.mark.slow
+def test_bench_smoke_storm_full(tmp_path):
+    import bench
+
+    result = bench.smoke_storm(str(tmp_path / "storm_full.jsonl"))
+    assert result["ok"], result["errors"] or result
+    assert result["hedge"]["wins"] >= 1
+    assert result["hedge"]["p99_on_ms"] <= 0.75 * result["hedge"]["p99_off_ms"]
+    assert len(result["health"]["ready_replicas"]) == 3

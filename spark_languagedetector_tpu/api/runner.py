@@ -41,6 +41,15 @@ from ..ops import score as score_ops
 from ..ops import score_fused
 from ..ops import score_hist
 from ..ops import score_pallas
+from ..ops.encode_device import (
+    DocBlock,
+    chunk_table,
+    encode_batch,
+    gather_wire,
+    utf8_safe_lengths,
+    wire_capacity,
+    wire_from_docs,
+)
 from ..ops.encoding import (
     ENCODINGS,
     RAGGED_CHUNK,
@@ -255,6 +264,15 @@ class BatchRunner:
     # the data-axis sharding of the padded batch is what GSPMD partitions;
     # a replicated flat buffer would forfeit the sharded transfer.
     ragged_transfer: bool | None = None
+    # Device-side encode (docs/PERFORMANCE.md §11): ship each batch as raw
+    # concatenated document bytes + int32 offsets and rebuild the padded
+    # [B, S] plane inside the same jit as the scorer (one XLA gather), so
+    # the host never materializes a padded or chunk-aligned buffer. None ⇒
+    # exec.config resolution (LANGDETECT_DEVICE_ENCODE, default off — the
+    # tuner stamps it on from a measured capture). Forced off on a mesh
+    # (the data-axis sharding partitions the padded plane, not the wire);
+    # DocBlock inputs always encode — that is the input form's point.
+    device_encode: bool | None = None
     # Cuckoo membership (ops.cuckoo.CuckooTable, host arrays) for exact
     # vocabs with gram lengths > 3 — routed through the gather-style
     # dispatch with packed-key lookups instead of a LUT.
@@ -337,6 +355,14 @@ class BatchRunner:
         self._degraded_mode = False
         if self.ragged_transfer is None:
             self.ragged_transfer = self.mesh is None
+        if self.device_encode is None:
+            self.device_encode = bool(exec_config.resolve("device_encode"))
+        if self.mesh is not None:
+            self.device_encode = False
+        # Per-(has_limit) jitted encode+score closures (the device-encode
+        # dispatch); built lazily under _state_lock, compiled per bucketed
+        # (wire, B, S) shape like every other strategy program.
+        self._encode_fns: dict = {}
         if self.mesh is not None:
             if self.device is not None:
                 raise ValueError("pass either device or mesh, not both")
@@ -1096,11 +1122,78 @@ class BatchRunner:
         batch = unpack_ragged_jit(flat, offs, lengths, pad_to)
         return self._dispatch_device(batch, lengths, window_limit, placement)
 
+    def _encode_fn(self, has_limit: bool):
+        """The device-encode program: encode gather + strategy scorer under
+        ONE jit (``pad_to`` static), so XLA fuses the padded-plane rebuild
+        into the scoring program — no intermediate host form, and for the
+        kernel strategies the pallas call simply inlines after the gather.
+        Lazily built per limit arity; jax.jit traces at first call, outside
+        the state lock."""
+        # Materialize lazy strategy state (quantized tables, membership
+        # planes) eagerly: letting the first touch happen under the trace
+        # would cache tracers in the state slots.
+        if self.strategy == "fused":
+            self._fused_state()
+        elif self.strategy == "pallas":
+            self._pallas_state()
+        elif self.strategy == "hybrid":
+            self._hybrid_state()
+            self._hist_supported()
+        elif self.strategy == "hist":
+            self._hist_supported()
+        fn = self._encode_fns.get(has_limit)
+        if fn is None:
+            with self._state_lock:
+                fn = self._encode_fns.get(has_limit)
+                if fn is None:
+                    if has_limit:
+                        def encode_and_score(
+                            wire, starts, lengths, window_limit, pad_to
+                        ):
+                            batch = encode_batch(wire, starts, lengths, pad_to)
+                            return self._strategy_scores(
+                                batch, lengths, window_limit, None
+                            )
+                    else:
+                        def encode_and_score(wire, starts, lengths, pad_to):
+                            batch = encode_batch(wire, starts, lengths, pad_to)
+                            return self._strategy_scores(
+                                batch, lengths, None, None
+                            )
+                    fn = jax.jit(
+                        encode_and_score, static_argnames=("pad_to",)
+                    )
+                    self._encode_fns[has_limit] = fn
+        return fn
+
+    def _dispatch_encoded(self, wire_np, starts_np, lengths_np, limit_np,
+                          placement, pad_to: int):
+        """Device-encode dispatch: ship raw concatenated bytes + int32
+        offsets only (docs/PERFORMANCE.md §11 — no host padding, no
+        chunk-row alignment; the wire is the documents) and run the fused
+        encode+score program. The rebuilt batch is bit-identical to the
+        padded path's, so scores are exact on every strategy."""
+        wire = jax.device_put(wire_np, placement)
+        starts = jax.device_put(starts_np, placement)
+        lengths = jax.device_put(lengths_np, placement)
+        fn = self._encode_fn(limit_np is not None)
+        if limit_np is None:
+            return fn(wire, starts, lengths, pad_to=pad_to)
+        window_limit = jax.device_put(limit_np, placement)
+        return fn(wire, starts, lengths, window_limit, pad_to=pad_to)
+
     def _dispatch_device(self, batch, lengths, window_limit, placement):
         # Chaos hook: an armed FaultPlan can fail/delay this attempt (the
         # compiled fast path and the degraded ladder's device level both
         # count as device dispatches).
         faults.inject("score/dispatch")
+        return self._strategy_scores(batch, lengths, window_limit, placement)
+
+    def _strategy_scores(self, batch, lengths, window_limit, placement):
+        """The strategy lattice's pure dispatch: one packed batch to the
+        configured scorer, no host side effects — safe to trace under the
+        device-encode jit (the encode gather and the scorer then compile
+        into one program) and shared verbatim by the eager dispatches."""
         if self.strategy == "fused":
             return self._fused_scores(batch, lengths, window_limit, placement)
         if self.strategy == "pallas":
@@ -1301,11 +1394,18 @@ class BatchRunner:
             "degraded ladder exhausted with no recorded cause"
         )
 
-    def score(self, byte_docs: Sequence[bytes]) -> np.ndarray:
-        """float32 [N, L] scores in input order (exact over any doc length)."""
+    def score(self, byte_docs) -> np.ndarray:
+        """float32 [N, L] scores in input order (exact over any doc length).
+
+        ``byte_docs`` is a sequence of ``bytes`` — or an
+        ``ops.encode_device.DocBlock`` (one byte plane + offsets), the
+        zero-copy all-unique lane: no per-document Python objects, the
+        wire ships raw bytes + int32 offsets, and the padded batch is
+        rebuilt on device inside the scoring jit
+        (docs/PERFORMANCE.md §11)."""
         return self._execute(byte_docs, want_labels=False)
 
-    def predict_ids(self, byte_docs: Sequence[bytes]) -> np.ndarray:
+    def predict_ids(self, byte_docs) -> np.ndarray:
         """int32 [N] argmax language indices in input order.
 
         The label path fetches per-doc int32 ids instead of [N, L] float
@@ -1317,7 +1417,7 @@ class BatchRunner:
         """
         return self._execute(byte_docs, want_labels=True)
 
-    def _execute(self, byte_docs: Sequence[bytes], *, want_labels: bool):
+    def _execute(self, byte_docs, *, want_labels: bool):
         # Flight-recorder hook: a raising score call dumps the recent
         # telemetry ring (when LANGDETECT_FLIGHT_RECORDER armed it) before
         # propagating — the post-mortem shows the batches leading up to
@@ -1328,26 +1428,54 @@ class BatchRunner:
             flightrec.record_crash("score", e)
             raise
 
-    def _execute_traced(self, byte_docs: Sequence[bytes], *, want_labels: bool):
-        if self.max_score_bytes:
-            cap = self.max_score_bytes
-            if self.score_encoding == UTF8:
-                byte_docs = [truncate_utf8(d, cap) for d in byte_docs]
-            else:
-                byte_docs = [d[:cap] for d in byte_docs]
-        N_in = len(byte_docs)
-        # In-flight dedup (docs/PERFORMANCE.md §10), keyed on the encoded,
-        # truncated bytes — the exact content the kernel would see. Unique
-        # rows ride the wire and the dispatch; duplicates are satisfied by
-        # the scatter-back at the very end (``out = out[inverse]``). The
-        # dict build is the whole all-unique overhead.
+    def _execute_traced(self, byte_docs, *, want_labels: bool):
+        # The zero-copy tier (docs/PERFORMANCE.md §11): a DocBlock input
+        # keeps the corpus as one byte plane + offsets end to end —
+        # vectorized truncation, chunk arithmetic instead of chunk bytes,
+        # one wire gather per batch, device-side encode. A mesh still
+        # needs per-row padded sharding, so block inputs materialize docs
+        # there (exact, just not zero-copy).
+        block = byte_docs if isinstance(byte_docs, DocBlock) else None
+        if block is not None and self.mesh is not None:
+            byte_docs = [block.doc(i) for i in range(len(block))]
+            block = None
         inverse = None
-        if self.dedup and N_in > 1:
-            d = dedup_counted(byte_docs)
-            if d is not None:
-                first_idx, inverse, _ = d
-                byte_docs = [byte_docs[int(i)] for i in first_idx]
-        N = len(byte_docs)
+        if block is not None:
+            doc_starts = block.starts()
+            doc_lens = block.lengths()
+            if self.max_score_bytes:
+                cap = self.max_score_bytes
+                if self.score_encoding == UTF8:
+                    doc_lens = utf8_safe_lengths(
+                        block.flat, doc_starts, doc_lens, cap
+                    )
+                else:
+                    doc_lens = np.minimum(doc_lens, cap)
+            # The block lane is the all-unique lane (the traffic shape it
+            # exists for); content dedup would re-materialize per-doc
+            # bytes just to key on them, un-doing the zero-copy win, so
+            # it is skipped here regardless of the dedup setting.
+            N_in = N = len(block)
+        else:
+            if self.max_score_bytes:
+                cap = self.max_score_bytes
+                if self.score_encoding == UTF8:
+                    byte_docs = [truncate_utf8(d, cap) for d in byte_docs]
+                else:
+                    byte_docs = [d[:cap] for d in byte_docs]
+            N_in = len(byte_docs)
+            # In-flight dedup (docs/PERFORMANCE.md §10), keyed on the
+            # encoded, truncated bytes — the exact content the kernel
+            # would see. Unique rows ride the wire and the dispatch;
+            # duplicates are satisfied by the scatter-back at the very
+            # end (``out = out[inverse]``). The dict build is the whole
+            # all-unique overhead.
+            if self.dedup and N_in > 1:
+                d = dedup_counted(byte_docs)
+                if d is not None:
+                    first_idx, inverse, _ = d
+                    byte_docs = [byte_docs[int(i)] for i in first_idx]
+            N = len(byte_docs)
         L = self.weights.shape[1]
         if want_labels:
             out = np.zeros(N, dtype=np.int32)
@@ -1368,22 +1496,53 @@ class BatchRunner:
             placement = self.device
 
         # Expand long docs into chunks; each work item is
-        # (doc_index, chunk_bytes, owned_window_starts).
-        doc_idx: list[int] = []
-        chunks: list[bytes] = []
-        limits: list[int] = []
-        for i, doc in enumerate(byte_docs):
-            if len(doc) <= self.max_chunk:
-                doc_idx.append(i)
-                chunks.append(doc)
-                limits.append(self.max_chunk)  # no-op limit
-            else:
-                parts = chunk_document(doc, self.max_chunk, overlap)
-                for j, part in enumerate(parts):
+        # (doc_index, chunk_bytes-or-span, owned_window_starts). The block
+        # tier never cuts chunk bytes — chunks are (start, length) spans
+        # into the byte plane (ops.encode_device.chunk_table, the same
+        # expansion in (doc, rank) order).
+        if block is not None:
+            doc_idx_arr, chunk_starts, chunk_lens, limits_arr = chunk_table(
+                doc_starts, doc_lens, self.max_chunk, overlap
+            )
+            chunks = None
+            sizes = chunk_lens
+            n_chunks = int(chunk_lens.size)
+        else:
+            doc_idx: list[int] = []
+            chunks: list[bytes] = []
+            limits: list[int] = []
+            for i, doc in enumerate(byte_docs):
+                if len(doc) <= self.max_chunk:
                     doc_idx.append(i)
-                    chunks.append(part)
-                    # Non-final chunks own starts [0, stride); final owns all.
-                    limits.append(stride if j < len(parts) - 1 else self.max_chunk)
+                    chunks.append(doc)
+                    limits.append(self.max_chunk)  # no-op limit
+                else:
+                    parts = chunk_document(doc, self.max_chunk, overlap)
+                    for j, part in enumerate(parts):
+                        doc_idx.append(i)
+                        chunks.append(part)
+                        # Non-final chunks own starts [0, stride); final
+                        # owns all.
+                        limits.append(
+                            stride if j < len(parts) - 1 else self.max_chunk
+                        )
+            doc_idx_arr = np.asarray(doc_idx, dtype=np.int64)
+            limits_arr = np.asarray(limits, dtype=np.int64)
+            chunk_starts = chunk_lens = None
+            sizes = [len(c) for c in chunks]
+            n_chunks = len(chunks)
+
+        def chunk_bytes(sel):
+            """Materialized chunk bytes for one planned batch — the
+            padded/ragged/degraded packers' input; the encode path never
+            calls it."""
+            if chunks is not None:
+                return [chunks[k] for k in sel]
+            flat = block.flat
+            return [
+                flat[s : s + ln].tobytes()
+                for s, ln in zip(chunk_starts[sel], chunk_lens[sel])
+            ]
 
         # Micro-batch plan through the shared execution core
         # (exec.core.plan_micro_batches): chunks grouped by padded-length
@@ -1393,7 +1552,6 @@ class BatchRunner:
         # A bucket's ragged remainder is carried into the next (wider)
         # bucket instead of becoming its own under-filled batch, so the
         # whole call ends with at most one ragged tail batch.
-        sizes = [len(c) for c in chunks]
         plan = plan_micro_batches(
             sizes,
             length_buckets=self.length_buckets,
@@ -1406,7 +1564,7 @@ class BatchRunner:
         # the exact population the bucket-width solver replays — recorded
         # here, after truncation and chunking, because this is the
         # population the lattice actually pads.
-        if sizes:
+        if len(sizes):
             edges = np.minimum(
                 -(-np.maximum(np.asarray(sizes, dtype=np.int64), 1) // 64)
                 * 64,
@@ -1416,13 +1574,77 @@ class BatchRunner:
                 REGISTRY.incr(f"exec/len/{int(edge)}", int(cnt))
         from ..utils.profiling import trace
 
+        use_encode = self.mesh is None and (
+            self.device_encode or block is not None
+        )
+
+        def encode_and_dispatch(sel: np.ndarray, pad_to: int):
+            """The device-encode rung: assemble the batch's wire form (raw
+            concatenated bytes + int32 offsets, bucketed capacity) and run
+            the fused encode+score program. The block tier gathers spans
+            straight off the byte plane; the list tier joins the chunk
+            bytes once — either way no padded or chunk-aligned host buffer
+            ever exists. An injected ``score/pack`` fault (or a real wire-
+            build failure) rides the shared retry/degraded wiring, whose
+            ladder re-packs on the host — the exact fallback."""
+            rows = len(sel)
+            blim = limits_arr[sel]
+            limit_np = (
+                None if bool((blim == self.max_chunk).all())
+                else blim.astype(np.int32)
+            )
+            if block is not None:
+                real_bytes = int(chunk_lens[sel].sum())
+            else:
+                batch_docs = [chunks[k] for k in sel]
+                real_bytes = sum(len(d) for d in batch_docs)
+            capacity = wire_capacity(real_bytes, rows, pad_to)
+            with span("score/pack", parent=score_span, rows=rows,
+                      pad_to=pad_to, wire=True):
+                # Chaos hook: the wire build is this path's pack stage.
+                faults.inject("score/pack")
+                if block is not None:
+                    wire_np, starts_np, lengths_np = gather_wire(
+                        block.flat, chunk_starts[sel], chunk_lens[sel],
+                        capacity,
+                    )
+                else:
+                    wire_np, starts_np, lengths_np = wire_from_docs(
+                        batch_docs, capacity
+                    )
+            # Observed after the wire build succeeds, so chaos retries
+            # never double-count shipped bytes.
+            fill = real_bytes / capacity if capacity else 1.0
+            REGISTRY.observe("score/batch_fill_ratio", fill)
+            REGISTRY.observe("score/padding_waste", 1.0 - fill)
+            REGISTRY.incr("score/real_bytes", real_bytes)
+            REGISTRY.incr("score/capacity_bytes", capacity)
+            index_bytes = starts_np.nbytes + lengths_np.nbytes + (
+                0 if limit_np is None else limit_np.nbytes
+            )
+            REGISTRY.incr("score/wire_bytes", capacity + index_bytes)
+            REGISTRY.incr("score/wire_docs", rows)
+            # The tuner's evidence that the encode path is live (and the
+            # smoke gates' A/B discriminator).
+            REGISTRY.incr("score/encoded_batches")
+            with span("score/dispatch", parent=score_span, rows=rows,
+                      pad_to=pad_to, wire=True) as sp:
+                scores = self._dispatch_encoded(
+                    wire_np, starts_np, lengths_np, limit_np, placement,
+                    pad_to,
+                )
+                sp.fence(scores)
+            return scores
+
         def build_and_dispatch(sel: np.ndarray, pad_to: int):
             """Pack one planned batch from the retained chunks and dispatch
             it. Re-invocable: scoring is stateless, so a transient failure is
             retried by replaying the batch verbatim — the micro-batch analog
             of the streaming loop's replay-once (SURVEY.md §5.3)."""
-            batch_docs = [chunks[k] for k in sel]
-            batch_limits = [limits[k] for k in sel]
+            if use_encode:
+                return encode_and_dispatch(sel, pad_to)
+            batch_docs = chunk_bytes(sel)
+            batch_limits = [int(x) for x in limits_arr[sel]]
             if self.mesh is not None:
                 # Sharded dispatch needs the row count divisible by the
                 # data axis; empty-doc pad rows score zero and are
@@ -1446,7 +1668,7 @@ class BatchRunner:
             # waste like any other padding.
             real_bytes = sum(len(d) for d in batch_docs)
 
-            def observe_fill(capacity: int) -> None:
+            def observe_fill(capacity: int, index_bytes: int) -> None:
                 fill = real_bytes / capacity if capacity else 1.0
                 REGISTRY.observe("score/batch_fill_ratio", fill)
                 REGISTRY.observe("score/padding_waste", 1.0 - fill)
@@ -1455,6 +1677,12 @@ class BatchRunner:
                 # what the tune smoke gate and the compare guard read.
                 REGISTRY.incr("score/real_bytes", real_bytes)
                 REGISTRY.incr("score/capacity_bytes", capacity)
+                # Wire-shrink accounting (docs/PERFORMANCE.md §11): every
+                # byte this dispatch ships — buffer plus index arrays — on
+                # every transfer form, so compare's score/wire_bytes_per_doc
+                # guard sees a silent fallback to a fatter form.
+                REGISTRY.incr("score/wire_bytes", capacity + index_bytes)
+                REGISTRY.incr("score/wire_docs", len(batch_docs))
 
             if (
                 self.ragged_transfer
@@ -1480,7 +1708,10 @@ class BatchRunner:
                     round_chunks(total, step) * RAGGED_CHUNK
                     < len(batch_docs) * pad_to
                 ):
-                    observe_fill(round_chunks(total, step) * RAGGED_CHUNK)
+                    observe_fill(
+                        round_chunks(total, step) * RAGGED_CHUNK,
+                        8 * len(batch_docs),
+                    )
                     with span("score/pack", parent=score_span,
                               rows=len(batch_docs), pad_to=pad_to):
                         flat_np, offs_np, lengths_np = native.pack_ragged(
@@ -1494,7 +1725,7 @@ class BatchRunner:
                         )
                         sp.fence(scores)
                     return scores
-            observe_fill(len(batch_docs) * pad_to)
+            observe_fill(len(batch_docs) * pad_to, 4 * len(batch_docs))
             with span("score/pack", parent=score_span,
                       rows=len(batch_docs), pad_to=pad_to):
                 batch_np, lengths_np = self._pack(batch_docs, pad_to)
@@ -1506,16 +1737,19 @@ class BatchRunner:
                 sp.fence(scores)
             return scores
 
-        doc_idx_arr = np.asarray(doc_idx, dtype=np.int64)
         # Chunked docs (len > max_chunk) need their full score rows fetched
         # and summed across chunks before argmax; everything else fetches
         # one int32 per doc in label mode.
         chunk_rank: dict[int, int] = {}
         chunk_acc = None
         if want_labels:
-            for i, doc in enumerate(byte_docs):
-                if len(doc) > self.max_chunk:
-                    chunk_rank.setdefault(i, len(chunk_rank))
+            if block is not None:
+                for i in np.flatnonzero(doc_lens > self.max_chunk):
+                    chunk_rank[int(i)] = len(chunk_rank)
+            else:
+                for i, doc in enumerate(byte_docs):
+                    if len(doc) > self.max_chunk:
+                        chunk_rank.setdefault(i, len(chunk_rank))
             if chunk_rank:
                 chunk_acc = np.zeros((len(chunk_rank), L), dtype=np.float32)
 
@@ -1528,7 +1762,10 @@ class BatchRunner:
             if not chunk_rank:  # common case: skip the per-row host scan
                 return am, None, _no_pos
             pos = np.asarray(
-                [p for p, k in enumerate(sel) if doc_idx[k] in chunk_rank],
+                [
+                    p for p, k in enumerate(sel)
+                    if int(doc_idx_arr[k]) in chunk_rank
+                ],
                 dtype=np.int64,
             )
             sub = scores[jnp.asarray(pos)] if pos.size else None
@@ -1547,9 +1784,11 @@ class BatchRunner:
 
         def degraded_for(sel, pad_to, cause):
             """Assemble the planned batch's docs/limits (mesh pad rows
-            included) and run them down the degradation ladder."""
-            batch_docs = [chunks[k] for k in sel]
-            batch_limits = [limits[k] for k in sel]
+            included) and run them down the degradation ladder. Block-fed
+            batches materialize their chunk bytes here — the ladder's
+            host-pack rung is the exact fallback either way."""
+            batch_docs = chunk_bytes(sel)
+            batch_limits = [int(x) for x in limits_arr[sel]]
             if self.mesh is not None:
                 batch_docs, batch_limits = pad_rows_for_mesh(
                     batch_docs, self._ndata, (batch_limits, self.max_chunk)
@@ -1736,7 +1975,10 @@ class BatchRunner:
                         whole = np.ones(len(sel), dtype=bool)
                         if pos.size:
                             whole[pos] = False
-                            rows = [chunk_rank[doc_idx[sel[p]]] for p in pos]
+                            rows = [
+                                chunk_rank[int(doc_idx_arr[sel[p]])]
+                                for p in pos
+                            ]
                             np.add.at(chunk_acc, rows, sub_host)
                         out[docs_of[whole]] = am_host[: len(sel)][whole]
                     else:
@@ -1760,7 +2002,7 @@ class BatchRunner:
             "runner.score",
             docs=N_in,
             unique=N,
-            chunks=len(chunks),
+            chunks=n_chunks,
             batches=len(plan),
             trace_id=req_id,
         )

@@ -90,6 +90,10 @@ KNOBS: dict[str, Knob] = _knobs(
          positive=True),
     Knob("pack_threads", "LANGDETECT_PACK_THREADS", "int", None,
          "native packer thread count (unset: auto)", positive=True),
+    Knob("device_encode", "LANGDETECT_DEVICE_ENCODE", "bool", False,
+         "device-side batch encode: ship raw bytes + int32 offsets and "
+         "rebuild the padded batch inside the scoring jit instead of "
+         "host-packing (docs/PERFORMANCE.md §11)", tunable=True),
     # --- redundancy elimination (docs/PERFORMANCE.md §10) -----------------
     Knob("dedup", "LANGDETECT_DEDUP", "bool", True,
          "in-flight content dedup: unique rows ride the wire/kernel, "

@@ -268,6 +268,11 @@ def ordered_prefetch(
         # instant. Aborted: cancel what hasn't started, and join (or
         # not) per ``abort_wait`` — see the docstring.
         ex.shutdown(wait=drained or abort_wait, cancel_futures=True)
+        # Drop queued (item, future) pairs deterministically: zero-copy
+        # producers hand out views into caller-owned buffers (Arrow pools,
+        # DocBlock planes), and a generator closed mid-stream must not pin
+        # them until the GC gets around to the deque.
+        in_flight.clear()
 
 
 # --------------------------------------------------- retry/degrade wiring ---

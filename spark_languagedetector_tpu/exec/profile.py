@@ -94,6 +94,13 @@ TUNED_FIELDS["cache_rows"] = _positive_int("cache_rows")
 TUNED_FIELDS["cache_bytes"] = _positive_int("cache_bytes")
 
 
+@_tuned("device_encode")
+def _check_device_encode(v):
+    if not isinstance(v, bool):
+        raise ValueError(f"device_encode must be a bool, got {v!r}")
+    return v
+
+
 @dataclass(frozen=True)
 class TuningProfile:
     """One deployment's measured execution defaults (validated)."""

@@ -245,6 +245,34 @@ def solve_cache(signals: dict) -> dict:
     return {"cache_rows": int(rows), "cache_bytes": int(cache_bytes)}
 
 
+# ----------------------------------------------------------- wire solver ----
+def solve_wire(signals: dict) -> dict:
+    """Device-encode stamping from the capture's measured padding tax
+    (docs/PERFORMANCE.md §11).
+
+    Emits ``device_encode: True`` when the capture proves either (a) the
+    replayed deployment already ran the wire path
+    (``score/encoded_batches`` > 0 — keep what worked), or (b) scoring
+    traffic padded badly: whole-run fill ``score/real_bytes /
+    score/capacity_bytes`` below 0.85, meaning ≥15% of every transfer was
+    padding the device-encode wire form would simply not ship. A capture
+    with no scoring traffic (or dense, well-filled batches where the
+    padded path's single pre-padded put is already near-optimal) emits
+    nothing — the knob's built-in default stands through normal config
+    fallback rather than recording an unmeasured guess as "tuned".
+    """
+    counters = signals["counters"]
+    if float(counters.get("score/encoded_batches") or 0.0) > 0:
+        return {"device_encode": True}
+    real = float(counters.get("score/real_bytes") or 0.0)
+    capacity = float(counters.get("score/capacity_bytes") or 0.0)
+    if capacity <= 0:
+        return {}
+    if real / capacity < 0.85:
+        return {"device_encode": True}
+    return {}
+
+
 # --------------------------------------------------------- budget solver ----
 def solve_budgets(signals: dict, *, max_batch_ms: float | None) -> dict:
     """Per-transfer byte budgets. Without a latency constraint the
@@ -306,6 +334,7 @@ def solve(
     tuned.update(solve_budgets(signals, max_batch_ms=max_batch_ms))
     tuned.update(solve_serve(signals, p99_ms=p99_ms))
     tuned.update(solve_cache(signals))
+    tuned.update(solve_wire(signals))
 
     before = padded_bytes(bins, list(DEFAULT_LENGTH_BUCKETS))
     after = padded_bytes(bins, buckets)
@@ -328,6 +357,17 @@ def solve(
         # of submitted rows the dedup layer collapsed during the capture.
         "duplicate_mass": (
             round(1.0 - rows_unique / rows_in, 6) if rows_in > 0 else 0.0
+        ),
+        # Whole-run wire fill (the wire solver's evidence): real scored
+        # bytes over the capacity that actually shipped.
+        "score_wire_fill": (
+            round(
+                float(counters.get("score/real_bytes") or 0.0)
+                / float(counters.get("score/capacity_bytes") or 0.0),
+                6,
+            )
+            if float(counters.get("score/capacity_bytes") or 0.0) > 0
+            else None
         ),
         "padded_bytes_default_lattice": int(before),
         "padded_bytes_tuned_lattice": int(after),

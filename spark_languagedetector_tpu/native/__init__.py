@@ -182,7 +182,14 @@ def pack_batch(
     """Native padded packing: list[bytes] → (uint8 [B, pad_to], int32 [B]).
 
     Falls back to the numpy implementation when the library is unavailable.
+    A :class:`~..ops.encode_device.DocBlock` (one byte plane + offsets)
+    packs via a single vectorized scatter — no per-document Python bytes
+    are ever materialized (docs/PERFORMANCE.md §11).
     """
+    from ..ops.encode_device import DocBlock, pad_block
+
+    if isinstance(byte_docs, DocBlock):
+        return pad_block(byte_docs, pad_to)
     lib = _load()
     if lib is None:
         from ..ops.encoding import pad_batch as py_pad
@@ -219,10 +226,15 @@ def pack_ragged(
     ``ops.encoding.pack_ragged_numpy``, its host mirror and fallback).
 
     Offset/size bookkeeping is vectorized numpy either way; the native
-    library only replaces the per-document copy loop.
+    library only replaces the per-document copy loop. A
+    :class:`~..ops.encode_device.DocBlock` fills the flat buffer with one
+    vectorized scatter instead (docs/PERFORMANCE.md §11).
     """
+    from ..ops.encode_device import DocBlock, ragged_block
     from ..ops.encoding import RAGGED_CHUNK, pack_ragged_numpy, ragged_layout
 
+    if isinstance(byte_docs, DocBlock):
+        return ragged_block(byte_docs, pad_to, flat_step)
     lib = _load()
     if lib is None:
         return pack_ragged_numpy(byte_docs, pad_to, flat_step)

@@ -171,7 +171,13 @@ def pack_ragged_numpy(
     Host mirror of the native ``pack_ragged`` loader. ``offs[i]`` is doc
     i's first chunk row in ``flat`` (row 0 is the reserved zero chunk);
     docs longer than ``pad_to`` are truncated, matching ``pad_batch``.
+    A :class:`~.encode_device.DocBlock` fills via one vectorized scatter
+    instead of the per-document loop (docs/PERFORMANCE.md §11).
     """
+    from .encode_device import DocBlock, ragged_block
+
+    if isinstance(byte_docs, DocBlock):
+        return ragged_block(byte_docs, pad_to, flat_step)
     flat, offs, lengths = ragged_layout(byte_docs, pad_to, flat_step)
     view = flat.reshape(-1)
     for i, doc in enumerate(byte_docs):

@@ -262,6 +262,7 @@ def iter_device_batches(
     item_mult: np.ndarray | None = None,
     placement=None,
     ragged: bool = True,
+    device_encode: bool | None = None,
     ndata: int = 1,
     parent=None,
     depth: int = FIT_PIPELINE_DEPTH,
@@ -275,8 +276,12 @@ def iter_device_batches(
     A background packer (the execution core's :func:`ordered_prefetch`
     pipeline, one worker so packs stay plan-ordered) walks ``plan`` in
     order: native pack (ragged when the chunk-aligned flat buffer beats the
-    padded form — size precheck identical to the scoring runner's), mesh
-    row padding (``ndata`` > 1), async ``device_put`` to ``placement``,
+    padded form — size precheck identical to the scoring runner's; or the
+    device-encode wire form — raw bytes + int32 offsets, no host padding,
+    docs/PERFORMANCE.md §11 — when ``device_encode`` or the
+    ``LANGDETECT_DEVICE_ENCODE`` knob enables it on a single-process
+    direct-put geometry), mesh row padding (``ndata`` > 1), async
+    ``device_put`` to ``placement``,
     then an ordered hand-off — up to ``depth`` batches sit
     transferred-or-transferring beyond the one the consumer holds, so the
     count step never waits on the host. Ragged batches are rebuilt into the
@@ -302,6 +307,7 @@ def iter_device_batches(
     import jax
 
     from .. import native
+    from .encode_device import encode_batch_jit, wire_capacity, wire_from_docs
     from .encoding import unpack_ragged_jit
 
     native.available()  # one-time native build outside the pipelined loop
@@ -309,6 +315,12 @@ def iter_device_batches(
     # processes' devices is not portable on this jax version — ship host
     # arrays and let the pjit in_shardings place them at dispatch.
     explicit_put = placement is None or jax.process_count() == 1
+    if device_encode is None:
+        device_encode = bool(exec_config.resolve("device_encode"))
+    # The wire rung (docs/PERFORMANCE.md §11) ships raw bytes + int32
+    # offsets and rebuilds the padded plane on device; it needs a direct
+    # put and row counts the mesh padder hasn't reshaped.
+    device_encode = device_encode and ndata == 1 and explicit_put
 
     def pack_one(planned):
         sel, pad_to = planned
@@ -331,20 +343,30 @@ def iter_device_batches(
                 )
         rows = len(batch_docs)
         real_bytes = sum(len(d) for d in batch_docs)
-        use_ragged = False
+        form = "padded"
         flat_step = 0
         total = 0
-        if ragged and pad_to % RAGGED_CHUNK == 0:
+        if device_encode:
+            # Wire rung: raw bytes + int32 offsets, no host padding at all
+            # (the planner's chunk-split already bounds every doc ≤ pad_to,
+            # so the join is the exact truncated content).
+            form = "wire"
+        elif ragged and pad_to % RAGGED_CHUNK == 0:
             # Same precheck as the scoring runner: ragged only wins when the
             # bucketed flat buffer is actually smaller than the padded batch.
             flat_step = (rows * pad_to // RAGGED_CHUNK) // 16
             total = 1 + sum(
                 -(-min(len(d), pad_to) // RAGGED_CHUNK) for d in batch_docs
             )
-            use_ragged = (
-                round_chunks(total, flat_step) * RAGGED_CHUNK < rows * pad_to
-            )
-        if use_ragged:
+            if round_chunks(total, flat_step) * RAGGED_CHUNK < rows * pad_to:
+                form = "ragged"
+        if form == "wire":
+            capacity = wire_capacity(real_bytes, rows, pad_to)
+            with span("fit/pack", parent=parent, rows=rows, pad_to=pad_to,
+                      wire=True):
+                host = wire_from_docs(batch_docs, capacity)
+            REGISTRY.incr("fit/encoded_batches")
+        elif form == "ragged":
             capacity = round_chunks(total, flat_step) * RAGGED_CHUNK
             with span("fit/pack", parent=parent, rows=rows, pad_to=pad_to,
                       ragged=True):
@@ -385,7 +407,7 @@ def iter_device_batches(
                 sp.fence(*dev)
         else:
             dev, blangs_dev, bmult_dev = host, blangs, bmult
-        return (use_ragged, dev, blangs_dev, bmult_dev, rows, pad_to)
+        return (form, dev, blangs_dev, bmult_dev, rows, pad_to)
 
     # The core's bounded ordered pipeline, one packer worker: packs (and
     # their async puts) stay in deterministic plan order, up to ``depth``
@@ -399,8 +421,11 @@ def iter_device_batches(
     )
     try:
         for _, packed, _, _ in pipeline:
-            use_ragged, dev, blangs_dev, bmult_dev, rows, pad_to = packed()
-            if use_ragged:
+            form, dev, blangs_dev, bmult_dev, rows, pad_to = packed()
+            if form == "wire":
+                wire, wstarts, lengths = dev
+                batch = encode_batch_jit(wire, wstarts, lengths, pad_to)
+            elif form == "ragged":
                 flat, offs, lengths = dev
                 batch = unpack_ragged_jit(flat, offs, lengths, pad_to)
             else:

@@ -36,6 +36,12 @@ Sites (one hook per serving layer; docs/RESILIENCE.md §4):
   * ``score/dispatch`` — :meth:`BatchRunner._dispatch_device` (and the
     degraded ladder's device-gather level: it is still a device dispatch).
   * ``score/fetch``    — the runner's per-batch result fetch.
+  * ``score/pack``     — each device-encode wire build (the raw-bytes +
+    offsets gather feeding :meth:`BatchRunner._dispatch_encoded`): a
+    firing ``error`` fails the zero-copy lane before anything ships, so
+    the degraded ladder falls to the host-pack rung — scores stay
+    bit-identical, only the wire format degrades
+    (docs/PERFORMANCE.md §11).
   * ``stream/batch``   — each streaming transform attempt (error/delay)
     and each pulled source batch (poison).
   * ``fit/count``      — the fit count stage (host pass or each device
@@ -122,6 +128,7 @@ FAULT_PLAN_ENV = "LANGDETECT_FAULT_PLAN"
 SITES = (
     "score/dispatch",
     "score/fetch",
+    "score/pack",
     "stream/batch",
     "fit/count",
     "shard_step",

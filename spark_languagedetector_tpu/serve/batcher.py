@@ -751,8 +751,14 @@ class ContinuousBatcher:
 
     # ------------------------------------------------------------ admin -----
     def stats(self) -> dict:
-        """Queue/backpressure snapshot for /healthz."""
-        return self._queue.stats()
+        """Queue/backpressure snapshot for /healthz. Includes the resolved
+        ``device_encode`` knob (the runners this batcher dispatches into
+        inherit it at construction), so "is this replica on the wire path"
+        is a health-endpoint read, not log archaeology
+        (docs/PERFORMANCE.md §11)."""
+        out = self._queue.stats()
+        out["device_encode"] = bool(exec_config.resolve("device_encode"))
+        return out
 
     def close(self, drain: bool = True) -> None:
         """Stop admitting; by default drain queued requests first so no

@@ -173,6 +173,14 @@ _TRACKED_RATIOS = {
     # runs still carry the denominator and a zero numerator), so a
     # candidate that STARTS rejecting fails against a clean baseline.
     "segment/reject_rate": ("segment/rejects", "segment/docs"),
+    # Wire-wall contract metric (docs/PERFORMANCE.md §11): bytes shipped
+    # per scored document, exact from the dispatch's wire accounting. On
+    # a fixed replayed workload this regresses UPWARD (the default
+    # lower-is-better direction): the same corpus suddenly costing more
+    # wire per doc means the device-encode lane silently fell back to
+    # host padding — exactly the drift the fill_ratio[score/wire] guard
+    # can miss when the padded lattice happens to fill well.
+    "score/wire_bytes_per_doc": ("score/wire_bytes", "score/wire_docs"),
 }
 
 

@@ -440,6 +440,43 @@ def test_runner_injected_dispatch_fault_recovers():
     assert runner.breaker.state == "closed"  # one blip never trips
 
 
+def test_runner_injected_pack_fault_falls_to_host_rung():
+    """score/pack chaos (PERFORMANCE.md §11): a persistent fault in the
+    device-encode wire build drops that dispatch to the degraded ladder's
+    host-pack rung — scores stay bit-identical to the fault-free padded
+    path, and the degraded counters record the fallback."""
+    REGISTRY.reset()
+    want = _runner().score(_docs())
+    runner = _runner(device_encode=True, degraded_fallback=True)
+    with faults.plan_scope(FaultPlan.parse("score/pack:error")):
+        got = runner.score(_docs())
+    np.testing.assert_array_equal(got, want)
+    counters = REGISTRY.snapshot()["counters"]
+    assert counters.get("resilience/degraded_batches", 0) > 0
+    assert counters.get("resilience/degraded_host", 0) > 0
+    # The persistent fault tripped the breaker, so THIS runner keeps
+    # serving exact scores from the ladder; a fresh runner (closed
+    # breaker, no fault plan) takes the wire path again, bit-exact.
+    np.testing.assert_array_equal(runner.score(_docs()), want)
+    np.testing.assert_array_equal(
+        _runner(device_encode=True).score(_docs()), want
+    )
+    assert REGISTRY.snapshot()["counters"].get("score/encoded_batches", 0) > 0
+
+
+def test_runner_injected_pack_fault_transient_retries_in_lane():
+    """A one-shot score/pack blip is retryable in the fast lane (the
+    wire build replays under the retry policy before any ladder step),
+    so a transient never costs the wire format."""
+    runner = _runner(device_encode=True)
+    docs = _docs()
+    want = _runner().score(docs)
+    with faults.plan_scope(FaultPlan.parse("score/pack:error@1")):
+        got = runner.score(docs)
+    np.testing.assert_array_equal(got, want)
+    assert runner.metrics.snapshot()["counters"]["retries"] == 1
+
+
 def test_runner_injected_fetch_fault_replays():
     runner = _runner()
     docs = _docs()

@@ -1,0 +1,210 @@
+"""Device-side encode + zero-copy ingest (ISSUE 19, PERFORMANCE.md §11).
+
+The wire-wall contract: the device-encode path's host half (UTF-8-safe
+truncation, chunk windowing, wire gather) must be bit-identical to the
+scalar host-pack oracles in ``ops.encoding``, the device half
+(``encode_batch``) must rebuild exactly ``pad_batch``'s padded plane, and
+the DocBlock zero-copy tier (numpy/Arrow-backed bytes viewed, never
+re-materialized as Python objects) must feed every packer with the same
+bits as the list[bytes] tier.
+"""
+
+import numpy as np
+import pytest
+
+from spark_languagedetector_tpu import native
+from spark_languagedetector_tpu.ops import encode_device as ed
+from spark_languagedetector_tpu.ops.encoding import (
+    chunk_document,
+    pack_ragged_numpy,
+    pad_batch,
+    truncate_utf8,
+)
+
+
+def _corpus(rng, n=40):
+    docs = [
+        bytes(rng.integers(0, 256, int(rng.integers(0, 300))).tolist())
+        for _ in range(n)
+    ]
+    docs += [b"", b"a", b"\x80" * 130, b"\xc3" + b"\xa9" * 299,
+             b"x" * 126 + "€".encode() * 3, "é".encode() * 100]
+    return docs
+
+
+# ---------------------------------------------------------------- DocBlock --
+def test_docblock_from_bytes_round_trips():
+    rng = np.random.default_rng(0)
+    docs = _corpus(rng)
+    block = ed.DocBlock.from_bytes(docs)
+    assert len(block) == len(docs)
+    assert block.total_bytes == sum(len(d) for d in docs)
+    assert [block.doc(i) for i in range(len(docs))] == docs
+    np.testing.assert_array_equal(
+        block.lengths(), [len(d) for d in docs]
+    )
+
+
+def test_docblock_from_arrow_views_buffers_zero_copy():
+    pa = pytest.importorskip("pyarrow")
+    docs = [b"alpha", b"", b"\xc3\xa9" * 50, b"tail"]
+    for typ in (pa.binary(), pa.large_binary()):
+        arr = pa.array(docs, type=typ)
+        block = ed.DocBlock.from_arrow(arr)
+        assert [block.doc(i) for i in range(len(block))] == docs
+    # sliced arrays honor the offset window
+    arr = pa.array([b"drop"] + docs, type=pa.binary()).slice(1)
+    block = ed.DocBlock.from_arrow(arr)
+    assert [block.doc(i) for i in range(len(block))] == docs
+    # nulls cannot ride the wire silently
+    with pytest.raises(ValueError, match="null"):
+        ed.DocBlock.from_arrow(pa.array([b"a", None], type=pa.binary()))
+
+
+def test_docblock_from_arrow_string_chunked():
+    pa = pytest.importorskip("pyarrow")
+    chunked = pa.chunked_array([["héllo", "wörld"], ["x" * 200]])
+    block = ed.DocBlock.from_arrow(chunked)
+    assert [block.doc(i) for i in range(len(block))] == [
+        "héllo".encode(), "wörld".encode(), b"x" * 200
+    ]
+
+
+# ------------------------------------------------------- vectorized oracles --
+def test_utf8_safe_lengths_matches_truncate_utf8():
+    rng = np.random.default_rng(1)
+    docs = _corpus(rng, n=200)
+    block = ed.DocBlock.from_bytes(docs)
+    for cap in (1, 2, 7, 128, 256):
+        got = ed.utf8_safe_lengths(
+            block.flat, block.starts(), block.lengths(), cap
+        )
+        want = [len(truncate_utf8(d, cap)) for d in docs]
+        np.testing.assert_array_equal(got, want, err_msg=f"cap={cap}")
+
+
+def test_chunk_table_matches_chunk_document():
+    rng = np.random.default_rng(2)
+    lengths = np.array(
+        [0, 1, 127, 128, 129, 255, 256, 257, 700, 1000]
+        + list(rng.integers(0, 1200, 50)),
+        dtype=np.int64,
+    )
+    starts = np.zeros(lengths.size, dtype=np.int64)
+    np.cumsum(lengths[:-1], out=starts[1:])
+    for chunk_size, overlap in ((256, 4), (256, 2), (128, 1)):
+        doc_of, c_starts, c_lens, limits = ed.chunk_table(
+            starts, lengths, chunk_size, overlap
+        )
+        e_doc, e_start, e_len, e_lim = [], [], [], []
+        stride = chunk_size - overlap
+        for i, (s, ln) in enumerate(zip(starts, lengths)):
+            doc = b"\0" * int(ln)
+            chunks = chunk_document(doc, chunk_size, overlap)
+            for k, c in enumerate(chunks):
+                e_doc.append(i)
+                e_start.append(int(s) + k * stride)
+                e_len.append(len(c))
+                # the runner's rule: non-final chunks own window starts
+                # [0, stride); the final chunk owns all of its starts
+                e_lim.append(
+                    stride if k < len(chunks) - 1 else chunk_size
+                )
+        np.testing.assert_array_equal(doc_of, e_doc)
+        np.testing.assert_array_equal(c_starts, e_start)
+        np.testing.assert_array_equal(c_lens, e_len)
+        np.testing.assert_array_equal(limits, e_lim)
+
+
+# ----------------------------------------------------------------- the wire --
+def test_gather_wire_matches_wire_from_docs():
+    rng = np.random.default_rng(3)
+    docs = _corpus(rng)
+    block = ed.DocBlock.from_bytes(docs)
+    w1, s1, l1 = ed.gather_wire(block.flat, block.starts(), block.lengths())
+    w2, s2, l2 = ed.wire_from_docs(docs)
+    np.testing.assert_array_equal(w1, w2)
+    np.testing.assert_array_equal(s1, s2)
+    np.testing.assert_array_equal(l1, l2)
+    assert s1.dtype == np.int32 and l1.dtype == np.int32
+    with pytest.raises(ValueError, match="capacity"):
+        ed.wire_from_docs(docs, capacity=3)
+
+
+def test_wire_capacity_buckets_and_bounds():
+    # never exceeds the padded size, always fits the real bytes
+    for rows, pad_to in ((8, 128), (64, 256), (512, 512)):
+        padded = rows * pad_to
+        step = max(256, padded // 16)
+        for total in (0, 1, 200, padded // 2, padded - 1, padded):
+            cap = ed.wire_capacity(total, rows, pad_to)
+            assert max(total, 1) <= cap <= padded
+            assert cap == padded or cap % step == 0
+    # the bucket lattice stays small: <= ~17 distinct sizes per geometry
+    caps = {ed.wire_capacity(t, 64, 256) for t in range(0, 64 * 256 + 1, 37)}
+    assert len(caps) <= 17
+
+
+def test_encode_batch_jit_rebuilds_pad_batch_exactly():
+    rng = np.random.default_rng(4)
+    docs = [d[:256] for d in _corpus(rng)]
+    for pad_to in (128, 256):
+        capped = [d[:pad_to] for d in docs]
+        wire, starts, lengths = ed.wire_from_docs(capped)
+        got = np.asarray(ed.encode_batch_jit(wire, starts, lengths, pad_to))
+        want, want_lens = pad_batch(capped, pad_to=pad_to)
+        np.testing.assert_array_equal(got, want)
+        np.testing.assert_array_equal(lengths, want_lens)
+
+
+# ----------------------------------------------- zero-copy packer delegation --
+def test_native_packers_accept_docblock_bit_exact():
+    rng = np.random.default_rng(5)
+    docs = _corpus(rng)
+    block = ed.DocBlock.from_bytes(docs)
+    for pad_to in (128, 256):
+        for a, b in zip(
+            native.pack_batch(docs, pad_to), native.pack_batch(block, pad_to)
+        ):
+            np.testing.assert_array_equal(a, b)
+        for a, b in zip(
+            native.pack_ragged(docs, pad_to), native.pack_ragged(block, pad_to)
+        ):
+            np.testing.assert_array_equal(a, b)
+        for a, b in zip(
+            pack_ragged_numpy(docs, pad_to), pack_ragged_numpy(block, pad_to)
+        ):
+            np.testing.assert_array_equal(a, b)
+
+
+# --------------------------------------------------------------- the gate ---
+def test_bench_smoke_wire_trimmed(tmp_path):
+    """Tier-1-sized wire smoke: all-unique short docs A/B'd host-pack vs
+    device-encode — bit-exact parity on gather + fused (knob and DocBlock
+    tiers), >=2x wire bytes/doc reduction, degraded host-pack rung under a
+    persistent score/pack fault, exactly like the CI gate (the wall-clock
+    speedup gate runs full-size only)."""
+    import bench
+
+    result = bench.smoke_wire(str(tmp_path / "wire.jsonl"), trimmed=True)
+    assert result["ok"], result
+    assert result["parity"]["knob_bit_exact"]
+    assert result["parity"]["block_bit_exact"]
+    assert result["parity"]["fused_bit_exact"]
+    assert result["parity"]["degraded_bit_exact"]
+    assert result["parity"]["degraded_argmax"] == 1.0
+    assert result["wire"]["reduction"] >= 2.0
+    assert result["wire"]["encoded_batches"] > 0
+    assert result["degraded_batches"] > 0
+
+
+@pytest.mark.slow
+def test_bench_smoke_wire_full(tmp_path):
+    """Full-size wire smoke incl. the >=1.3x all-unique end-to-end
+    wall-clock gate (slow-marked: CI runs it via
+    ``bench.py --smoke-wire``)."""
+    import bench
+
+    result = bench.smoke_wire(str(tmp_path / "wire_full.jsonl"))
+    assert result["ok"], result
+    assert result["speedup_all_unique"] >= 1.3

@@ -204,6 +204,59 @@ def test_ordered_prefetch_close_stops_worker():
     assert len(started) <= 5
 
 
+def test_ordered_prefetch_close_releases_produced_buffers():
+    """View-lifetime hazard (PERFORMANCE.md §11): a zero-copy producer
+    hands out buffers backed by caller-owned memory (Arrow pools,
+    DocBlock planes). A generator closed mid-stream must drop its queued
+    (item, future) pairs deterministically — not when the GC finds the
+    deque — or the pool cannot reclaim the freed buffer."""
+    import weakref
+
+    refs = []
+
+    def produce(i):
+        buf = np.full(4096, i, dtype=np.uint8)
+        refs.append(weakref.ref(buf))
+        return buf
+
+    gen = core.ordered_prefetch(range(10), produce, depth=3, workers=1)
+    item, thunk, _, _ = next(gen)
+    thunk()
+    gen.close()
+    del thunk  # the yielded future is the consumer's own reference
+    assert refs  # the pipeline did run ahead
+    assert all(r() is None for r in refs)
+
+
+def test_ordered_prefetch_close_cannot_pin_arrow_buffers():
+    """The satellite regression for the real ingest shape: producers that
+    wrap Arrow string arrays into DocBlocks must not keep the Arrow
+    buffers alive past close() — the block's ``owners`` tuple is the only
+    thing pinning them, and the cleared deque drops it."""
+    import weakref
+
+    pa = pytest.importorskip("pyarrow")
+
+    from spark_languagedetector_tpu.ops.encode_device import DocBlock
+
+    arrays = [
+        pa.array([f"doc-{i}-{j}" * 8 for j in range(64)], type=pa.binary())
+        for i in range(8)
+    ]
+    refs = [weakref.ref(a) for a in arrays]
+
+    def produce(i):
+        block = DocBlock.from_arrow(arrays[i])
+        return block
+
+    gen = core.ordered_prefetch(range(8), produce, depth=3, workers=1)
+    _, thunk, _, _ = next(gen)
+    assert len(thunk()) == 64
+    gen.close()
+    del arrays, thunk, gen
+    assert all(r() is None for r in refs)
+
+
 # ------------------------------------------------ core: guarded dispatch ----
 def test_guarded_dispatch_fast_path_and_recovered_hook():
     policy = RetryPolicy(max_attempts=2, base_delay_s=0.0)
@@ -672,6 +725,39 @@ def test_compare_tracks_score_wire_fill_from_counters():
     _, regressions = compare_captures(base, worse, threshold=0.25)
     assert any("fill_ratio[score/wire]" in r for r in regressions)
     _, regressions = compare_captures(worse, base, threshold=0.25)
+    assert not regressions
+
+
+def test_compare_tracks_wire_bytes_per_doc_lower_better():
+    """The silent-fallback guard (PERFORMANCE.md §11): on a fixed
+    replayed corpus, bytes shipped per scored document rising means the
+    device-encode lane fell back to host padding — UP is the regression,
+    DOWN (the wire path engaging) never is, and a wire-path capture also
+    reports a higher fill_ratio[score/wire] without tripping that
+    (higher-better) guard."""
+
+    def ev(wire_bytes, docs, real, cap):
+        return [
+            {"event": "telemetry.span", "ts": 1.0, "path": "score",
+             "wall_s": 0.01},
+            {"event": "telemetry.snapshot", "ts": 2.0,
+             "counters": {"score/wire_bytes": wire_bytes,
+                          "score/wire_docs": docs,
+                          "score/real_bytes": real,
+                          "score/capacity_bytes": cap},
+             "gauges": {}, "histograms": {}},
+        ]
+
+    # device-encode baseline: ~48B/doc wire, tight fill
+    encode = capture_stats(ev(48_000, 1000, 40_000, 44_000))
+    # host-pack fallback on the SAME corpus: ~132B/doc, loose fill
+    padded = capture_stats(ev(132_000, 1000, 40_000, 128_000))
+    assert encode["tracked"]["score/wire_bytes_per_doc"] == pytest.approx(48.0)
+    assert padded["tracked"]["score/wire_bytes_per_doc"] == pytest.approx(132.0)
+    _, regressions = compare_captures(encode, padded, threshold=0.25)
+    assert any("score/wire_bytes_per_doc" in r for r in regressions)
+    # engaging the wire path is never a regression, on either guard
+    _, regressions = compare_captures(padded, encode, threshold=0.25)
     assert not regressions
 
 

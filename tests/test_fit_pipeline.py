@@ -187,6 +187,30 @@ def test_ragged_transfer_taken_and_parity():
     np.testing.assert_allclose(got_w, want_w, rtol=1e-6, atol=1e-7)
 
 
+def test_device_encode_fit_taken_and_parity(monkeypatch):
+    """The wire rung (PERFORMANCE.md §11): with LANGDETECT_DEVICE_ENCODE
+    on, fit ingest ships raw bytes + int32 offsets and rebuilds the
+    padded plane inside the jit — and the fitted profile stays
+    bit-identical to the host-pack fit, chunk-split oversized docs
+    included."""
+    rng = np.random.default_rng(23)
+    docs = [
+        bytes(rng.integers(97, 105, int(rng.integers(20, 90)), dtype=np.uint8))
+        for _ in range(255)
+    ]
+    docs.append(bytes(rng.integers(97, 105, 600, dtype=np.uint8)))
+    langs = np.arange(256) % 3
+    spec = VocabSpec(EXACT, (1, 2))
+    want_ids, want_w = fit_profile_device(docs, langs, 3, spec, 30, PARITY)
+    monkeypatch.setenv("LANGDETECT_DEVICE_ENCODE", "1")
+    before = REGISTRY.snapshot()["counters"].get("fit/encoded_batches", 0)
+    got_ids, got_w = fit_profile_device(docs, langs, 3, spec, 30, PARITY)
+    after = REGISTRY.snapshot()["counters"].get("fit/encoded_batches", 0)
+    assert after > before, "expected at least one wire-form fit batch"
+    np.testing.assert_array_equal(got_ids, want_ids)
+    np.testing.assert_array_equal(got_w, want_w)
+
+
 def test_fit_telemetry_spans_and_histograms():
     """Telemetry parity with the scoring path: fit/pack + fit/put spans and
     batch fill / padding-waste histograms are recorded by the device fit."""

@@ -13,6 +13,7 @@ import pytest
 
 from spark_languagedetector_tpu.api.runner import BatchRunner
 from spark_languagedetector_tpu.models.profile import GramProfile
+from spark_languagedetector_tpu.ops.encode_device import DocBlock
 from spark_languagedetector_tpu.ops.score import score_batch_numpy
 from spark_languagedetector_tpu.ops.vocab import EXACT, HASHED, VocabSpec
 
@@ -98,4 +99,74 @@ def test_all_strategies_match_host_scorer(case_idx):
         np.testing.assert_array_equal(
             runner.predict_ids(docs), np.argmax(got, axis=1),
             err_msg=f"{spec} strategy={strategy} labels",
+        )
+
+
+def _encode_docs(rng):
+    """The device-encode hazard corpus: random docs, empties, chunked
+    oversized docs, and UTF-8 continuation bytes (0x80-0xBF) straddling
+    the truncation cap so the safe-truncation backscan has work to do."""
+    docs = _docs(rng)
+    docs += [
+        b"x" * 254 + "€".encode() * 40,   # 3-byte seq split at cap 256
+        b"\x80" * 300,                     # continuation-only, every cap
+        b"\xc3" + b"\xa9" * 299,           # one lead byte then tail
+        "é".encode() * 200,                # 2-byte seqs, cap lands mid-seq
+    ]
+    return docs
+
+
+# Tier-1 runs a representative subset (dense-exact gather+fused, the
+# widest exact gram span, hashed with in-kernel FNV); the remaining
+# cases are slow-marked — jit programs compile per runner instance and
+# the full sweep costs minutes the tier-1 budget doesn't have.
+_ENCODE_TIER1 = {0, 5, 6}
+
+
+@pytest.mark.parametrize(
+    "case_idx",
+    [
+        pytest.param(
+            i,
+            marks=() if i in _ENCODE_TIER1 else (pytest.mark.slow,),
+            id=str(CASES[i][0]),
+        )
+        for i in range(len(CASES))
+    ],
+)
+def test_device_encode_matches_host_pack_bit_exact(case_idx):
+    """Device-encode parity fuzz (PERFORMANCE.md §11): the wire path —
+    raw concatenated bytes + int32 offsets, padded batch rebuilt inside
+    the scoring jit — must be BIT-identical to the host-pack path, on
+    both the list[bytes] tier (knob on) and the DocBlock tier, for every
+    (spec, strategy) the lattice can produce that covers gather + fused.
+    """
+    spec, strategies = CASES[case_idx]
+    rng = np.random.default_rng(2000 + case_idx)
+    profile = _profile(spec, rng)
+    docs = _encode_docs(rng)
+    block = DocBlock.from_bytes(docs)
+    weights, lut, cuckoo = profile.device_membership()
+    for strategy in strategies:
+        if strategy not in ("gather", "fused"):
+            continue
+        def runner(**kw):
+            return BatchRunner(
+                weights=weights, lut=lut, cuckoo=cuckoo, spec=spec,
+                strategy=strategy, length_buckets=(128, 256), batch_size=8,
+                **kw,
+            )
+        # One host-pack runner serves both references: jit programs
+        # compile per runner INSTANCE, and a DocBlock input engages the
+        # wire path structurally even with the knob off — so the same
+        # instance covers the host oracle AND the zero-copy tier.
+        base = runner()
+        want = base.score(docs)
+        got_knob = runner(device_encode=True).score(docs)
+        np.testing.assert_array_equal(
+            got_knob, want, err_msg=f"{spec} strategy={strategy} knob tier"
+        )
+        got_block = base.score(block)
+        np.testing.assert_array_equal(
+            got_block, want, err_msg=f"{spec} strategy={strategy} block tier"
         )

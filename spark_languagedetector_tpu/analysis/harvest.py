@@ -440,6 +440,7 @@ class CompareContracts:
     reliability_counters: dict[str, int] = field(default_factory=dict)
     reliability_prefixes: dict[str, int] = field(default_factory=dict)
     informational_counters: dict[str, int] = field(default_factory=dict)
+    cold_start_histograms: dict[str, int] = field(default_factory=dict)
 
 
 def compare_contracts(compare: PyFile | None) -> CompareContracts:
@@ -464,6 +465,7 @@ def compare_contracts(compare: PyFile | None) -> CompareContracts:
         ("_RELIABILITY_COUNTERS", out.reliability_counters),
         ("_RELIABILITY_COUNTER_PREFIXES", out.reliability_prefixes),
         ("_INFORMATIONAL_COUNTERS", out.informational_counters),
+        ("_COLD_START_HISTOGRAMS", out.cold_start_histograms),
     ):
         node = _module_assign(compare, const)
         for s in _str_elements(node, compare.consts):

@@ -299,6 +299,15 @@ def check_telemetry_names(scan: Scan) -> list[Violation]:
                 "emit at least one counter under the prefix or drop it "
                 "from _RELIABILITY_COUNTER_PREFIXES",
             ))
+    for name, line in sorted(cc.cold_start_histograms.items()):
+        if not em.hist(name):
+            out.append(Violation(
+                "R2", COMPARE_REL, line,
+                f"cold-start histogram {name!r} is diffed but never "
+                "emitted",
+                "emit it via REGISTRY.observe or drop it from "
+                "_COLD_START_HISTOGRAMS",
+            ))
     for name, (line, kind, is_prefix) in sorted(tune.items()):
         if is_prefix:
             ok = em.any_prefix_overlap(name)

@@ -2009,15 +2009,37 @@ class BatchRunner:
         # Roofline gauges, once per runner: XLA's cost model for this
         # runner's dispatch program at a shape it actually ran, so
         # stage_summary can state achieved-vs-peak utilization. Pure
-        # diagnostics — never allowed to fail the call.
+        # diagnostics, so they run off the dispatch path: the analysis
+        # re-lowers (and on CPU re-compiles) the dispatch program, which
+        # would otherwise stall the first post-spawn dispatch for seconds
+        # (docs/PERFORMANCE.md §12). Join ``_cost_thread`` to wait for
+        # the gauges.
         if plan and not getattr(self, "_cost_recorded", False):
             self._cost_recorded = True
-            try:
-                from ..telemetry import cost as cost_mod
+            rows, pad_to = len(plan[0][0]), plan[0][1]
 
-                cost_mod.record_runner_cost(self, len(plan[0][0]), plan[0][1])
-            except Exception:
-                pass
+            def _record():
+                try:
+                    from ..resilience import faults
+                    from ..telemetry import cost as cost_mod
+
+                    # Shielded: the analysis re-traces _dispatch_device,
+                    # whose chaos hook would otherwise consume a fault
+                    # plan's call budget in this fault-swallowing thread.
+                    with faults.shield():
+                        cost_mod.record_runner_cost(self, rows, pad_to)
+                except Exception:
+                    pass
+
+            # Non-daemon on purpose: a daemon thread killed mid-XLA-compile
+            # at interpreter exit aborts the process (C++ terminate); the
+            # table-size guard in telemetry/cost bounds how long exit can
+            # wait on the join.
+            t = threading.Thread(
+                target=_record, name="runner-cost-gauges", daemon=False
+            )
+            self._cost_thread = t
+            t.start()
         return out
 
     def predict(self, byte_docs: Sequence[bytes], languages: Sequence[str]) -> list[str]:

@@ -1,0 +1,33 @@
+"""The cold-start plane: persistent compile cache + mmap-baked artifacts.
+
+``artifacts/`` makes the Nth spawn of a geometry compile nothing and
+parse nothing (docs/PERFORMANCE.md §12): :mod:`.compile_cache` wires
+JAX's persistent compilation cache and traces the bounded shape lattice;
+:mod:`.bake` lays the trained tables out as raw little-endian blocks a
+replica loads with ``np.memmap`` instead of a parquet parse.
+"""
+
+from .bake import (
+    ArtifactError,
+    artifact_path_for,
+    bake_artifact,
+    bake_model,
+    load_artifact,
+    load_baked_model,
+    maybe_load_baked,
+    recover_artifact,
+)
+from .compile_cache import enable_compile_cache, prewarm_lattice
+
+__all__ = [
+    "ArtifactError",
+    "artifact_path_for",
+    "bake_artifact",
+    "bake_model",
+    "enable_compile_cache",
+    "load_artifact",
+    "load_baked_model",
+    "maybe_load_baked",
+    "prewarm_lattice",
+    "recover_artifact",
+]

@@ -1,0 +1,500 @@
+"""Baked model artifacts: mmap-ready tables for the cold-start plane.
+
+The parquet model tree (persist/io) is the durable interchange format —
+Spark-readable, schema-checked, quantization-coded — but loading it is a
+*parse*: every row round-trips through Arrow into Python lists, ids are
+re-sorted, and the device membership tables (dense table / LUT / cuckoo)
+are rebuilt from scratch in every process. A fleet scale-up pays that per
+replica; a 32-tenant zoo pays it per cold load.
+
+A baked artifact is the same model pre-laid-out for *page-in*: raw
+little-endian numpy blocks (quantized int8/int16 rows or f64 weights,
+sorted ids, the LUT or cuckoo state, the f32 device table) behind one JSON
+header. Loading is ``np.memmap`` — no parse, no table rebuild, and N
+replicas on one host share the page cache because they map the same file.
+
+Layout (a directory, like the parquet tree it shadows)::
+
+    <name>.baked/
+      header.json   format/version, class/uid/paramMap/vocab/languages,
+                    calibration, quantization scales, device form,
+                    cuckoo seeds, the block table, file_bytes
+      blocks.bin    4096-aligned little-endian blocks + 8-byte end magic
+
+Crash-atomicity follows ``persist.io.save_model`` exactly: the tree is
+built under a ``.<name>.tmp.<pid>`` sibling and swapped in with the
+two-rename protocol; :func:`recover_artifact` mirrors
+``persist.io.recover_fit_state`` — when the root is missing it promotes
+the newest sibling that FULLY validates (a SIGKILL mid-build leaves a torn
+tmp whose header parses but whose blocks are truncated; the
+``file_bytes``/end-magic check refuses it), and deletes other siblings
+only after a successful promotion.
+
+Bit-parity contract: a quantized bake stores the same integer rows and
+per-language f32 scales as the parquet quantization codec, and the loader
+reconstructs weights with the identical exact-f64 product — so a baked
+model scores bit-identically to the parquet-loaded one (pinned by
+tests/test_artifacts.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from pathlib import Path
+
+import numpy as np
+
+from ..models.profile import (
+    DENSE_TABLE_BUDGET_BYTES,
+    GramProfile,
+    quantize_weights,
+)
+from ..ops.vocab import EXACT, VocabSpec
+from ..telemetry.registry import REGISTRY
+from ..utils.logging import get_logger, log_event
+
+_log = get_logger("artifacts.bake")
+
+FORMAT = "ldbake"
+FORMAT_VERSION = 1
+HEADER_NAME = "header.json"
+BLOCKS_NAME = "blocks.bin"
+ARTIFACT_SUFFIX = ".baked"
+# Every block starts on an mmap page boundary so a reader faults exactly
+# the pages it touches — no block straddles another's tail page.
+_ALIGN = 4096
+# Written after the last block, once everything before it is on disk. A
+# truncated blocks.bin (the SIGKILL-mid-build shape) cannot carry it, so
+# presence + the header's file_bytes is the torn-write detector — cheap
+# enough to keep load a page-in (a content checksum would read every page
+# up front and defeat lazy faulting).
+MAGIC = b"LDBAKED1"
+
+
+class ArtifactError(ValueError):
+    """A baked artifact that must not be loaded (torn, foreign, or from a
+    different format version)."""
+
+
+# ----------------------------------------------------------------- paths ----
+def artifact_path_for(
+    model_path: str | Path, artifact_dir: str | None = None, env=os.environ
+) -> Path:
+    """Where the baked artifact for ``model_path`` lives.
+
+    ``LANGDETECT_ARTIFACT_DIR`` (or the explicit ``artifact_dir``) names a
+    directory holding ``<model name>.baked`` trees; unset, the artifact is
+    a ``<model>.baked`` sibling of the model tree — so a model directory
+    copied with its siblings carries its artifact along.
+    """
+    from ..exec import config as exec_config
+
+    resolved = exec_config.resolve("artifact_dir", artifact_dir, env)
+    base = Path(model_path)
+    if resolved:
+        return Path(resolved) / (base.name + ARTIFACT_SUFFIX)
+    return base.parent / (base.name + ARTIFACT_SUFFIX)
+
+
+# ------------------------------------------------------------------ bake ----
+def _device_form(compact: GramProfile, budget: int):
+    """(form, blocks, cuckoo_meta): the numpy mirror of
+    ``GramProfile.device_membership`` at f32, so the baked tables are
+    bit-identical to what ``LanguageDetectorModel.load(...)._get_runner()``
+    would build from the parquet tree."""
+    from ..ops.cuckoo import build_cuckoo
+    from ..ops.vocab import MAX_DEVICE_ID_GRAM_LEN, gram_key
+
+    spec = compact.spec
+    L = compact.num_languages
+    if spec.mode == EXACT and max(spec.gram_lengths) > MAX_DEVICE_ID_GRAM_LEN:
+        keys = [gram_key(spec.id_to_gram(int(i))) for i in compact.ids]
+        keys_lo = np.asarray([k[0] for k in keys], dtype=np.int32)
+        keys_hi = np.asarray([k[1] for k in keys], dtype=np.int32)
+        table = build_cuckoo(keys_lo, keys_hi)
+        w = np.concatenate(
+            [compact.weights, np.zeros((1, L), compact.weights.dtype)]
+        ).astype(np.float32)
+        blocks = [
+            ("dev_weights", w),
+            ("cuckoo_slots", table.slots),
+            ("cuckoo_keys_lo", table.keys_lo),
+            ("cuckoo_keys_hi", table.keys_hi),
+        ]
+        return "cuckoo", blocks, {"seed1": table.seed1, "seed2": table.seed2}
+    V = spec.id_space_size
+    dense_bytes = V * L * 4
+    compact_bytes = V * 4 + (compact.num_grams + 1) * L * 4
+    use_dense = dense_bytes <= budget and (
+        (spec.mode == EXACT and max(spec.gram_lengths) <= 2)
+        or dense_bytes <= 4 * compact_bytes
+    )
+    if use_dense:
+        return "dense", [("dev_dense", compact._dense_table(np.float32))], None
+    G = compact.num_grams
+    w = np.concatenate(
+        [compact.weights, np.zeros((1, L), compact.weights.dtype)]
+    ).astype(np.float32)
+    lut = np.full(V, G, dtype=np.int32)
+    lut[compact.ids] = np.arange(G, dtype=np.int32)
+    return "lut", [("dev_weights", w), ("dev_lut", lut)], None
+
+
+def bake_artifact(
+    path: str | Path,
+    profile: GramProfile,
+    uid: str,
+    params: dict,
+    *,
+    calibration: dict | None = None,
+    quantize: str | None = None,
+    dense_budget_bytes: int = DENSE_TABLE_BUDGET_BYTES,
+) -> str:
+    """Write the baked artifact directory for one model (overwrite
+    semantics, crash-atomic).
+
+    ``quantize`` ('int8' | 'int16') stores integer rows + per-language f32
+    scales — the exact codec ``persist.io.save_model(quantize=...)`` uses,
+    so both paths reconstruct the identical f64 weight matrix. None bakes
+    the raw f64 rows.
+    """
+    compact = profile.compacted()
+    arrays: list[tuple[str, np.ndarray]] = [
+        ("ids", np.ascontiguousarray(compact.ids, dtype=np.int64))
+    ]
+    quant_meta = None
+    if quantize is not None:
+        q, scales = quantize_weights(compact.weights, quantize)
+        quant_meta = {
+            "dtype": quantize,
+            "scales": [float(s) for s in scales],
+        }
+        arrays.append(("weights_q", q))
+        # The device tables must mirror what a parquet load of this same
+        # codec would build — the dequantized q*scale product, NOT the
+        # pre-quantization weights — or baked scores drift from the
+        # parquet-loaded quantized model by one rounding step.
+        compact = GramProfile(
+            spec=compact.spec,
+            languages=compact.languages,
+            ids=compact.ids,
+            weights=q.astype(np.float64)
+            * np.asarray(scales, dtype=np.float64),
+        )
+    else:
+        arrays.append(
+            ("weights_f64", np.ascontiguousarray(compact.weights, np.float64))
+        )
+    form, dev_blocks, cuckoo_meta = _device_form(compact, dense_budget_bytes)
+    arrays.extend(dev_blocks)
+
+    blocks = []
+    offset = 0
+    for name, arr in arrays:
+        arr = np.ascontiguousarray(arr)
+        if arr.dtype.byteorder == ">":
+            arr = arr.astype(arr.dtype.newbyteorder("<"))
+        offset = -(-offset // _ALIGN) * _ALIGN
+        blocks.append(
+            {
+                "name": name,
+                "dtype": arr.dtype.str,
+                "shape": list(arr.shape),
+                "offset": offset,
+                "nbytes": int(arr.nbytes),
+            }
+        )
+        offset += int(arr.nbytes)
+    file_bytes = offset + len(MAGIC)
+
+    header = {
+        "format": FORMAT,
+        "version": FORMAT_VERSION,
+        "class": "spark_languagedetector_tpu.models.estimator."
+        "LanguageDetectorModel",
+        "uid": uid,
+        "paramMap": params,
+        "vocab": {
+            "mode": compact.spec.mode,
+            "gramLengths": list(compact.spec.gram_lengths),
+            "hashBits": compact.spec.hash_bits,
+            "hashScheme": compact.spec.hash_scheme,
+        },
+        "languages": list(compact.languages),
+        "calibration": calibration,
+        "quantization": quant_meta,
+        "device_form": form,
+        "dense_budget_bytes": int(dense_budget_bytes),
+        "cuckoo": cuckoo_meta,
+        "blocks": blocks,
+        "file_bytes": file_bytes,
+    }
+
+    root = Path(path)
+    tmp = root.parent / f".{root.name}.tmp.{os.getpid()}"
+    if tmp.exists():
+        shutil.rmtree(tmp)
+    tmp.mkdir(parents=True)
+    try:
+        with open(tmp / BLOCKS_NAME, "wb") as fh:
+            for spec_row, (_, arr) in zip(blocks, arrays):
+                fh.seek(spec_row["offset"])
+                fh.write(np.ascontiguousarray(arr).tobytes())
+            fh.seek(file_bytes - len(MAGIC))
+            fh.write(MAGIC)
+        (tmp / HEADER_NAME).write_text(json.dumps(header) + "\n")
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+    # Two-rename swap + stale-sibling sweep, same as persist.io.save_model.
+    backup = None
+    if root.exists():
+        backup = root.parent / f".{root.name}.old.{os.getpid()}"
+        if backup.exists():
+            shutil.rmtree(backup)
+        os.replace(root, backup)
+    try:
+        os.replace(tmp, root)
+    except BaseException:
+        if backup is not None:
+            os.replace(backup, root)
+        raise
+    if backup is not None:
+        shutil.rmtree(backup)
+    for stale in list(root.parent.glob(f".{root.name}.tmp.*")) + list(
+        root.parent.glob(f".{root.name}.old.*")
+    ):
+        shutil.rmtree(stale, ignore_errors=True)
+    log_event(
+        _log, "artifact.baked", path=str(root), grams=compact.num_grams,
+        device_form=form, quantize=quantize, bytes=file_bytes,
+    )
+    return str(root)
+
+
+def bake_model(model, path: str | Path, *, quantize: str | None = None) -> str:
+    """Bake a fitted/loaded :class:`LanguageDetectorModel` (convenience
+    over :func:`bake_artifact`)."""
+    calibration = model.calibration
+    return bake_artifact(
+        path,
+        model.profile,
+        model.uid,
+        model.param_metadata(),
+        calibration=None if calibration is None else calibration.to_dict(),
+        quantize=quantize,
+    )
+
+
+# ------------------------------------------------------------------ load ----
+# One live mapping per blocks file: every reader's block views slice the
+# same buffer, so concurrent loads in one process share pages by
+# construction (and across processes via the OS page cache). Keyed on
+# (realpath, size, mtime_ns) so a re-baked artifact maps fresh.
+_MMAP_CACHE: dict[tuple, np.memmap] = {}
+
+
+def _mapped(blocks_path: Path) -> np.memmap:
+    st = os.stat(blocks_path)
+    key = (os.path.realpath(blocks_path), st.st_size, st.st_mtime_ns)
+    mm = _MMAP_CACHE.get(key)
+    if mm is None:
+        mm = np.memmap(blocks_path, dtype=np.uint8, mode="r")
+        _MMAP_CACHE[key] = mm
+    return mm
+
+
+class BakedArtifact:
+    """A validated, mapped artifact: ``header`` + zero-copy block views."""
+
+    def __init__(self, path: Path, header: dict, buf: np.memmap):
+        self.path = path
+        self.header = header
+        self._buf = buf
+        self._blocks = {b["name"]: b for b in header["blocks"]}
+
+    def block(self, name: str) -> np.ndarray:
+        spec = self._blocks.get(name)
+        if spec is None:
+            raise ArtifactError(
+                f"{self.path}: no block {name!r}; artifact carries "
+                f"{sorted(self._blocks)}"
+            )
+        off, nbytes = spec["offset"], spec["nbytes"]
+        view = self._buf[off : off + nbytes].view(np.dtype(spec["dtype"]))
+        return view.reshape(tuple(spec["shape"]))
+
+
+def load_artifact(path: str | Path) -> BakedArtifact:
+    """Map + validate one baked artifact; raises :class:`ArtifactError`
+    on anything torn or foreign (the caller falls back to parquet)."""
+    root = Path(path)
+    header_path = root / HEADER_NAME
+    blocks_path = root / BLOCKS_NAME
+    try:
+        header = json.loads(header_path.read_text())
+    except (OSError, ValueError) as e:
+        raise ArtifactError(f"{root}: unreadable header: {e}") from e
+    if header.get("format") != FORMAT or header.get("version") != FORMAT_VERSION:
+        raise ArtifactError(
+            f"{root}: format {header.get('format')!r} v"
+            f"{header.get('version')!r}; this build reads {FORMAT} "
+            f"v{FORMAT_VERSION}"
+        )
+    file_bytes = header.get("file_bytes")
+    try:
+        actual = os.stat(blocks_path).st_size
+    except OSError as e:
+        raise ArtifactError(f"{root}: missing {BLOCKS_NAME}: {e}") from e
+    if actual != file_bytes:
+        # The SIGKILL-mid-build shape: header parses, blocks truncated.
+        raise ArtifactError(
+            f"{root}: {BLOCKS_NAME} holds {actual} bytes, header promises "
+            f"{file_bytes} — torn write, refusing to load"
+        )
+    buf = _mapped(blocks_path)
+    if bytes(buf[-len(MAGIC):]) != MAGIC:
+        raise ArtifactError(f"{root}: end magic missing — torn write")
+    for spec_row in header.get("blocks", ()):
+        end = spec_row["offset"] + spec_row["nbytes"]
+        if spec_row["offset"] % _ALIGN or end > file_bytes - len(MAGIC):
+            raise ArtifactError(
+                f"{root}: block {spec_row['name']!r} lies outside the "
+                f"mapped region"
+            )
+    return BakedArtifact(root, header, buf)
+
+
+def recover_artifact(path: str | Path) -> bool:
+    """Finish a bake swap a crash interrupted; True when recovered.
+
+    Mirrors ``persist.io.recover_fit_state``: when ``path`` is missing,
+    promote the newest ``.tmp``/``.old`` sibling that FULLY validates
+    (:func:`load_artifact` is the guard — a torn tmp's header parses but
+    its blocks fail the size/magic check), deleting the other siblings
+    only after a successful promotion. No-op when ``path`` exists.
+    """
+    root = Path(path)
+    if root.exists():
+        return False
+    candidates = list(root.parent.glob(f".{root.name}.tmp.*")) + list(
+        root.parent.glob(f".{root.name}.old.*")
+    )
+    candidates.sort(key=lambda p: p.stat().st_mtime, reverse=True)
+    for cand in candidates:
+        try:
+            load_artifact(cand)
+        except Exception:
+            continue  # torn/foreign candidate: never promote it
+        os.replace(cand, root)
+        for stale in list(root.parent.glob(f".{root.name}.tmp.*")) + list(
+            root.parent.glob(f".{root.name}.old.*")
+        ):
+            shutil.rmtree(stale, ignore_errors=True)
+        log_event(
+            _log, "artifact.recovered", path=str(root), source=cand.name
+        )
+        return True
+    return False
+
+
+def load_baked_model(path: str | Path):
+    """Artifact directory → ready :class:`LanguageDetectorModel`.
+
+    The host profile's weights come from the identical exact-f64
+    ``q * scale`` product the parquet loader computes, and the device
+    membership tables are attached pre-built (mmap views) so
+    ``_get_runner`` skips the LUT/cuckoo rebuild entirely.
+    """
+    art = load_artifact(path)
+    h = art.header
+    spec = VocabSpec(
+        h["vocab"]["mode"],
+        tuple(int(n) for n in h["vocab"]["gramLengths"]),
+        hash_bits=h["vocab"].get("hashBits", 20),
+        hash_scheme=h["vocab"].get("hashScheme", "fnv1a"),
+    )
+    ids = art.block("ids")
+    quant = h.get("quantization")
+    if quant is not None:
+        weights = art.block("weights_q").astype(np.float64) * np.asarray(
+            quant["scales"], dtype=np.float64
+        )
+    else:
+        weights = art.block("weights_f64")
+    profile = GramProfile(
+        spec=spec, languages=tuple(h["languages"]), ids=ids, weights=weights
+    )
+
+    from ..models.estimator import LanguageDetectorModel
+
+    model = LanguageDetectorModel(profile, uid=h["uid"])
+    model._set_params_from_metadata(h.get("paramMap", {}))
+    if h.get("calibration") is not None:
+        from ..segment.calibrate import Calibration
+
+        model.calibration = Calibration.from_dict(h["calibration"])
+
+    form = h["device_form"]
+    if form == "dense":
+        weights_dev, lut, cuckoo = art.block("dev_dense"), None, None
+    elif form == "lut":
+        weights_dev, lut, cuckoo = (
+            art.block("dev_weights"), art.block("dev_lut"), None,
+        )
+    else:
+        from ..ops.cuckoo import CuckooTable
+
+        weights_dev, lut = art.block("dev_weights"), None
+        cuckoo = CuckooTable(
+            slots=art.block("cuckoo_slots"),
+            keys_lo=art.block("cuckoo_keys_lo"),
+            keys_hi=art.block("cuckoo_keys_hi"),
+            seed1=int(h["cuckoo"]["seed1"]),
+            seed2=int(h["cuckoo"]["seed2"]),
+        )
+    model._prebuilt_membership = {
+        "dense_budget_bytes": int(h["dense_budget_bytes"]),
+        "weights": weights_dev,
+        "lut": lut,
+        "cuckoo": cuckoo,
+    }
+    REGISTRY.incr("artifacts/baked_loads")
+    return model
+
+
+def maybe_load_baked(
+    model_path: str | Path,
+    artifact: str | Path | None = None,
+    env=os.environ,
+):
+    """The cold-load fast path: the baked model when a valid artifact
+    exists for ``model_path``, else None (caller parses parquet).
+
+    Runs sibling-promotion recovery first, and treats every artifact
+    failure as a fallback, not an error — a torn or stale bake must never
+    take down a load the parquet tree can serve.
+    """
+    cand = (
+        Path(artifact)
+        if artifact is not None
+        else artifact_path_for(model_path, env=env)
+    )
+    try:
+        recover_artifact(cand)
+    except OSError:
+        pass
+    if not cand.exists():
+        return None
+    try:
+        return load_baked_model(cand)
+    except Exception as e:
+        REGISTRY.incr("artifacts/load_errors")
+        log_event(
+            _log, "artifact.load_failed", path=str(cand), error=str(e),
+            fallback="parquet",
+        )
+        return None

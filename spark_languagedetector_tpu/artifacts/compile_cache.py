@@ -1,0 +1,242 @@
+"""Persistent compile cache + shape-lattice prewarm for the cold-start plane.
+
+Every process that jits the scoring programs pays XLA compilation again —
+on a tunneled TPU a single batch shape costs a 20-40s remote compile
+(docs/PERFORMANCE.md §5), and even on CPU the per-bucket programs dominate
+a replica's spawn-to-READY time. Both halves of the fix live here:
+
+* :func:`enable_compile_cache` turns on JAX's persistent compilation
+  cache keyed by a directory resolved through ``exec/config``
+  (``LANGDETECT_COMPILE_CACHE_DIR``) — the Nth process to compile a given
+  (program, shape) reads the cache entry instead. The min-compile-time
+  and min-entry-size floors are zeroed: this framework's CPU programs
+  compile in milliseconds and would otherwise never be admitted, leaving
+  the cache warm only for the shapes that least need it.
+* :func:`prewarm_lattice` traces the bounded padded-length bucket lattice
+  the runner dispatches over (``exec/tune``'s closed compile-shape set,
+  resolvable from a :class:`TuningProfile`) — so a worker reaches READY
+  with every geometry it can serve either freshly compiled into the
+  shared cache (first spawn) or verified cache-warm (every spawn after:
+  a signature manifest written by the first full trace lets later spawns
+  prove the cache with one sentinel dispatch instead of re-tracing the
+  whole lattice — see :func:`prewarm_lattice`).
+
+Cache traffic is observable, not inferred from wall time: the
+``telemetry/gauges`` jax.monitoring hooks count ``compile_cache/hits``
+and ``compile_cache/misses`` — :func:`enable_compile_cache` installs them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+
+from ..telemetry.registry import REGISTRY, Registry
+from ..utils.logging import get_logger, log_event
+
+_log = get_logger("artifacts.compile_cache")
+
+
+def enable_compile_cache(
+    cache_dir: str | None = None, env=os.environ
+) -> str | None:
+    """Point JAX's persistent compilation cache at the resolved directory.
+
+    Resolution follows the audited precedence (explicit > env > default):
+    an unset knob returns None and leaves caching off — the status quo,
+    never a surprise tmpdir. Returns the live cache dir otherwise.
+    Idempotent; safe to call before or after the first jit.
+    """
+    from ..exec import config as exec_config
+
+    path = exec_config.resolve("compile_cache_dir", cache_dir, env)
+    if not path:
+        return None
+    os.makedirs(path, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", str(path))
+    # Admission floors default to "only slow, large compiles" — tuned for
+    # multi-minute TPU programs. This framework's lattice is a handful of
+    # small programs per geometry; admit everything or the cache stays
+    # cold exactly where the spawn path needs it. Option names drift
+    # across jax releases, so each update degrades independently.
+    for opt, val in (
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(opt, val)
+        except Exception:
+            pass
+    # jax binds the persistent-cache handle lazily at the FIRST compile
+    # and latches the result — a process that jitted anything before this
+    # call keeps the no-cache handle forever, so every later compile
+    # bypasses the directory just configured (and emits no hit/miss
+    # events, which would also blind the prewarm sentinel). Reset so the
+    # next compile re-initializes against the new configuration.
+    try:
+        from jax._src import compilation_cache as _jax_cc
+
+        _jax_cc.reset_cache()
+    except Exception:
+        pass
+    from ..telemetry.gauges import install_jax_hooks
+
+    install_jax_hooks()
+    log_event(_log, "compile_cache.enabled", path=str(path))
+    return str(path)
+
+
+def _lattice_signature(runner, buckets: tuple[int, ...]) -> dict:
+    """Everything the lattice's program set is keyed by, runner-side.
+
+    The persistent cache's true key is the optimized HLO hash; this
+    signature conservatively names the inputs that shape that HLO for the
+    dispatch programs — geometry knobs, table shapes/dtypes (values are
+    runtime args, so a weight refresh of identical shape legitimately
+    reuses the programs), and the jax/backend pair. A dimension this
+    misses degrades gracefully: the sentinel dispatch observes a cache
+    miss and the prewarm falls back to the full trace.
+    """
+    import jax
+
+    w = runner.weights
+    lut = runner.lut
+    return {
+        "schema": 1,
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "buckets": [int(b) for b in buckets],
+        "strategy": runner.strategy,
+        "quantization": runner.quantization,
+        "block": int(runner.block),
+        "batch_bytes": int(runner.batch_bytes),
+        "device_encode": bool(runner.device_encode),
+        "ragged_transfer": bool(runner.ragged_transfer),
+        "weights": [list(map(int, w.shape)), str(w.dtype)],
+        "lut": (
+            None if lut is None
+            else [list(map(int, lut.shape)), str(lut.dtype)]
+        ),
+        "cuckoo": runner.cuckoo is not None,
+        "vocab": [
+            runner.spec.mode, list(runner.spec.gram_lengths),
+            int(runner.spec.hash_bits),
+        ],
+    }
+
+
+def _manifest_path(cache_dir: str, sig: dict) -> str:
+    digest = hashlib.sha256(
+        json.dumps(sig, sort_keys=True).encode()
+    ).hexdigest()[:16]
+    return os.path.join(cache_dir, f"lattice-{digest}.manifest.json")
+
+
+def _hits() -> int:
+    return int(
+        REGISTRY.snapshot()["counters"].get("compile_cache/hits", 0)
+    )
+
+
+def prewarm_lattice(
+    runner,
+    profile=None,
+    registry: Registry | None = None,
+    cache_dir: str | None = None,
+) -> dict:
+    """Trace the padded-length bucket lattice the runner dispatches over.
+
+    One synthetic document is pinned to each bucket ceiling and scored in
+    its **own** call: a single batched call would let the planner coalesce
+    the chunked docs into one shared micro-batch geometry, tracing two or
+    three programs where serving traffic will hit one per bucket. Issuing
+    them separately compiles (or cache-hits) each bucket's dispatch
+    geometry before real traffic arrives. ``profile`` (a
+    :class:`~..exec.profile.TuningProfile`) overrides the bucket source;
+    by default the runner's own resolved lattice — which already consulted
+    the active profile through ``exec/config`` — is what gets traced.
+
+    **The verified-warm fast path.** Re-tracing N buckets whose programs
+    already sit in the persistent cache costs pure Python trace+lower
+    time per program — on a small host that tracing, not compilation, is
+    the warm spawn's floor. So a completed full trace records the
+    lattice's signature as a manifest next to the cache
+    (``lattice-<digest>.manifest.json``), and a later prewarm whose
+    signature matches traces ONE sentinel bucket and checks the
+    ``compile_cache/hits`` counter actually moved — an end-to-end proof
+    the cache serves this exact program set, not an mtime guess. The
+    remaining buckets defer to first touch, each a bounded trace +
+    cache-hit, never an XLA compile. A sentinel that misses (evicted or
+    foreign cache behind a stale manifest) self-heals: the full trace
+    runs and the manifest is rewritten. ``cache_dir`` is the live cache
+    directory (:func:`enable_compile_cache`'s return); None disables the
+    manifest path entirely and always traces the full lattice.
+
+    Returns ``{"buckets": [...], "seconds": ..., "mode": "full" |
+    "sentinel", "verified_hit": bool | None}`` and records the wall cost
+    as the ``artifacts/prewarm_s`` histogram: a warm cache shows up as
+    this distribution collapsing, not as a guess from spawn timing.
+    """
+    reg = registry if registry is not None else REGISTRY
+    buckets = None
+    if profile is not None:
+        buckets = profile.get("length_buckets")
+    if buckets is None:
+        buckets = runner.length_buckets
+    buckets = tuple(int(b) for b in buckets)
+
+    mode = "full"
+    verified_hit: bool | None = None
+    manifest = None
+    if cache_dir:
+        sig = _lattice_signature(runner, buckets)
+        manifest = _manifest_path(str(cache_dir), sig)
+        try:
+            with open(manifest, "r", encoding="utf-8") as f:
+                if json.load(f) == sig:
+                    mode = "sentinel"
+        except (OSError, ValueError):
+            pass
+
+    t0 = time.perf_counter()
+    if mode == "sentinel":
+        before = _hits()
+        runner.score([b"a" * buckets[0]])
+        verified_hit = _hits() > before
+        if not verified_hit:
+            # The manifest promised programs the cache no longer serves
+            # (eviction, a wiped dir, a foreign cache mounted at the same
+            # path). Fall back to the full trace — buckets[0] is already
+            # compiled by the sentinel — and re-earn the manifest below.
+            mode = "full"
+            for b in buckets[1:]:
+                runner.score([b"a" * b])
+    else:
+        for b in buckets:
+            runner.score([b"a" * b])
+    seconds = time.perf_counter() - t0
+
+    if mode == "full" and manifest is not None:
+        # Atomic (tmp + rename): the manifest is a promise later spawns
+        # skip work on — a torn one must parse as garbage, not as a
+        # plausible signature.
+        tmp = f"{manifest}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(sig, f, sort_keys=True)
+            os.replace(tmp, manifest)
+        except OSError:
+            pass
+    reg.observe("artifacts/prewarm_s", seconds)
+    log_event(
+        _log, "compile_cache.prewarmed", buckets=list(buckets),
+        seconds=round(seconds, 4), mode=mode, verified_hit=verified_hit,
+    )
+    return {
+        "buckets": list(buckets), "seconds": seconds, "mode": mode,
+        "verified_hit": verified_hit,
+    }

@@ -201,6 +201,16 @@ KNOBS: dict[str, Knob] = _knobs(
     Knob("scale_pidfile_dir", "LANGDETECT_SCALE_PIDFILE_DIR", "str", None,
          "pidfile directory for orphan reaping (unset: per-fleet-name "
          "tempdir)"),
+    # --- cold-start plane (artifacts/: docs/PERFORMANCE.md §12) -----------
+    Knob("compile_cache_dir", "LANGDETECT_COMPILE_CACHE_DIR", "str", None,
+         "persistent JAX compilation-cache directory shared across "
+         "replica spawns (unset: cache off, every process recompiles)"),
+    Knob("artifact_dir", "LANGDETECT_ARTIFACT_DIR", "str", None,
+         "baked-artifact directory consulted on model load (unset: look "
+         "for a `.baked` sibling of the model tree)"),
+    Knob("bake_on_save", "LANGDETECT_BAKE_ON_SAVE", "bool", False,
+         "bake an mmap-ready artifact next to every successful model "
+         "save so later cold loads page in instead of parsing parquet"),
     # --- resilience -------------------------------------------------------
     Knob("retry_max_attempts", "LANGDETECT_RETRY_MAX_ATTEMPTS", "int", 2,
          "retry attempts incl. the first try"),

@@ -646,6 +646,10 @@ class LanguageDetectorModel(HasInputCol, HasOutputCol, _ResultModeParams):
         state = self.__dict__.copy()
         state.pop("_runner_lock", None)
         state["_runner"] = None
+        # Baked-artifact membership tables are mmap views of a local file —
+        # meaningless (and unpicklable as views) in another process; copies
+        # rebuild membership from the profile like any other model.
+        state.pop("_prebuilt_membership", None)
         return state
 
     def __setstate__(self, state):
@@ -673,9 +677,27 @@ class LanguageDetectorModel(HasInputCol, HasOutputCol, _ResultModeParams):
                     # Sharding across devices makes the dense form
                     # affordable at device-count x the replication budget.
                     budget *= int(_np.prod(list(mesh.shape.values())))
-                weights, lut, cuckoo = self.profile.device_membership(
-                    dense_budget_bytes=budget
-                )
+                # The baked-artifact loader (artifacts.bake) attaches the
+                # device membership tables it mmapped — built under the
+                # default budget with no mesh. When this construction asks
+                # for exactly that shape, skip the LUT/cuckoo rebuild and
+                # hand the mapped views straight to the runner; any other
+                # geometry (vocab mesh, widened budget) rebuilds from the
+                # profile as before.
+                prebuilt = getattr(self, "_prebuilt_membership", None)
+                if (
+                    prebuilt is not None
+                    and mesh is None
+                    and budget == prebuilt["dense_budget_bytes"]
+                ):
+                    weights, lut, cuckoo = (
+                        prebuilt["weights"], prebuilt["lut"],
+                        prebuilt["cuckoo"],
+                    )
+                else:
+                    weights, lut, cuckoo = self.profile.device_membership(
+                        dense_budget_bytes=budget
+                    )
                 if backend == BACKEND_MESH_VOCAB and mesh is not None:
                     dense = (
                         lut is None

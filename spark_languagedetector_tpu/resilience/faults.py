@@ -372,6 +372,29 @@ def _next_call(site: str, channel: str) -> int:
         return _counters[key]
 
 
+_shield = threading.local()
+
+
+@contextmanager
+def shield():
+    """Suppress fault injection on THIS thread for the with-block.
+
+    Diagnostic side-paths — the runner's background roofline lowering
+    re-traces ``_dispatch_device`` off the serving path — execute
+    instrumented Python bodies without serving anything. Letting a chaos
+    plan fire there would consume a test's deterministic call budget in
+    a thread that swallows the fault, so the fault the plan aimed at the
+    *serving* attempt silently never lands. Shielded calls advance no
+    counters: the plan's call indices keep meaning serving attempts.
+    """
+    prev = getattr(_shield, "on", False)
+    _shield.on = True
+    try:
+        yield
+    finally:
+        _shield.on = prev
+
+
 def inject(site: str) -> None:
     """Chaos hook for ``error``/``delay`` faults at one execution attempt.
 
@@ -381,7 +404,7 @@ def inject(site: str) -> None:
     number so a chaos run's timeline is reconstructible from the JSONL.
     """
     plan = _plan
-    if plan is None:
+    if plan is None or getattr(_shield, "on", False):
         return
     call = _next_call(site, "exec")
     for spec in plan.specs:

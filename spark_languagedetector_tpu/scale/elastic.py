@@ -84,12 +84,20 @@ class ElasticFleet:
         child_env: dict | None = None,
         metrics_dir: str | None = None,
         slo: SloEvaluator | None = None,
+        compile_cache_dir: str | None = None,
+        artifact: str | None = None,
+        tuning_profile: str | None = None,
     ):
         self.supervisor = ReplicaSupervisor(
             model_path, host=host, platform=platform,
             fleet_name=fleet_name, pidfile_dir=pidfile_dir,
             prewarm=prewarm, spawn_timeout_s=spawn_timeout_s,
             child_env=child_env, metrics_dir=metrics_dir,
+            # Cold-start plane passthrough: every member (founders and
+            # autoscaler joiners alike) boots against the shared compile
+            # cache and the baked artifact (docs/PERFORMANCE.md §12).
+            compile_cache_dir=compile_cache_dir, artifact=artifact,
+            tuning_profile=tuning_profile,
         )
         self._host = host
         # Scale-up joiners may come up cold (compile folded into their

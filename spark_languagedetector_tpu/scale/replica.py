@@ -92,6 +92,8 @@ class ProcessReplica:
         spawn_timeout_s: float | None = None,
         tail_lines: int = 40,
         metrics_jsonl: str | None = None,
+        compile_cache_dir: str | None = None,
+        artifact: str | None = None,
     ):
         self.name = name
         self.model_path = str(model_path)
@@ -102,6 +104,20 @@ class ProcessReplica:
         self._env = dict(env or {})
         self._prewarm = prewarm
         self._metrics_jsonl = metrics_jsonl
+        # Cold-start plane handshake (docs/PERFORMANCE.md §12): the
+        # persistent compile-cache dir and baked-artifact path ride the
+        # child's argv, so the worker reaches READY having mmapped its
+        # tables and warmed (or cache-hit) its jit programs.
+        self._compile_cache_dir = compile_cache_dir
+        self._artifact = artifact
+        # Coordinator-side wall time of the last successful spawn (Popen
+        # to READY) and the child-reported warmup span (model load +
+        # lattice prewarm) off that spawn's READY line.
+        self.last_spawn_ready_s: float | None = None
+        self.last_warmup_s: float | None = None
+        # "full" | "sentinel" | None — how the child's lattice prewarm ran
+        # (sentinel = verified-warm manifest fast path).
+        self.last_prewarm_mode: str | None = None
         # Coordinator clock − child clock, measured at the READY
         # handshake (the child stamps its wall clock onto the READY
         # line). The stitch CLI uses the clock_sync event this emits to
@@ -165,6 +181,7 @@ class ProcessReplica:
             # for the pinned port.
             self.kill()
         faults.inject("scale/spawn")
+        t0 = time.monotonic()
         argv = [
             sys.executable, "-m", _WORKER_MODULE, self.model_path,
             "--name", self.name,
@@ -176,6 +193,20 @@ class ProcessReplica:
             argv.append("--no-prewarm")
         if self._metrics_jsonl:
             argv += ["--metrics-jsonl", self._metrics_jsonl]
+        if self._compile_cache_dir:
+            argv += ["--compile-cache-dir", self._compile_cache_dir]
+        # Re-resolved per attempt, not pinned at construction: an artifact
+        # baked between two spawns of the same member (cold fleet start,
+        # then a bake lands) is picked up by the next restart.
+        artifact = self._artifact
+        if artifact is None:
+            from ..artifacts.bake import artifact_path_for
+
+            candidate = artifact_path_for(self.model_path)
+            if candidate.exists():
+                artifact = str(candidate)
+        if artifact:
+            argv += ["--artifact", artifact]
         # Fresh per-spawn state, CAPTURED by this spawn's reader thread:
         # a stale reader from the previous incarnation (never joined —
         # it may be blocked on a half-dead pipe) still holds the OLD
@@ -214,6 +245,16 @@ class ProcessReplica:
                 )
         info = json.loads(ready_line[0][len(READY_PREFIX):])
         self._port = int(info["port"])
+        # Spawn-to-READY is the cold-start wall the artifacts plane exists
+        # to knock down; tracked as a regression histogram
+        # (telemetry/compare's cold-start set diffs its p50).
+        self.last_spawn_ready_s = time.monotonic() - t0
+        REGISTRY.observe("scale/spawn_ready_s", self.last_spawn_ready_s)
+        warmup = info.get("warmup_s")
+        self.last_warmup_s = (
+            float(warmup) if isinstance(warmup, (int, float)) else None
+        )
+        self.last_prewarm_mode = info.get("prewarm_mode")
         # Clock sync at the handshake: the child stamped its wall clock
         # onto the READY line *just* before we read it, so the difference
         # is the cross-process clock offset (± pipe latency, microseconds
@@ -232,6 +273,8 @@ class ProcessReplica:
         log_event(
             _log, "scale.replica.ready", replica=self.name, pid=self.pid,
             port=self._port, version=info.get("version"),
+            spawn_ready_s=round(self.last_spawn_ready_s, 4),
+            warmup_s=info.get("warmup_s"),
         )
         return self
 
@@ -353,11 +396,28 @@ class ReplicaSupervisor:
         retry_policy: RetryPolicy | None = None,
         child_env: dict | None = None,
         metrics_dir: str | None = None,
+        compile_cache_dir: str | None = None,
+        artifact: str | None = None,
+        tuning_profile: str | None = None,
     ):
         self.model_path = str(model_path)
         self._host = host
         self._platform = platform
         self._child_env = dict(child_env or {})
+        # Cold-start plane (docs/PERFORMANCE.md §12): spawn ships the
+        # compile-cache dir + baked-artifact path on the child's argv and
+        # the tuning profile through its env, so every member boots into
+        # a warm cache and an mmapped model. All resolved through the
+        # audited knob table — explicit ctor values beat env.
+        self._compile_cache_dir = exec_config.resolve(
+            "compile_cache_dir", compile_cache_dir
+        )
+        self._artifact = artifact
+        profile_path = exec_config.resolve("tuning_profile", tuning_profile)
+        if profile_path:
+            self._child_env.setdefault(
+                exec_config.PROFILE_ENV, str(profile_path)
+            )
         self.fleet_name = fleet_name
         # When set, every member writes its telemetry JSONL capture to
         # metrics_dir/replica-<name>.jsonl (append mode — restart
@@ -489,6 +549,8 @@ class ReplicaSupervisor:
                 os.path.join(self.metrics_dir, f"replica-{name}.jsonl")
                 if self.metrics_dir else None
             ),
+            compile_cache_dir=self._compile_cache_dir,
+            artifact=self._artifact,
         )
         self._spawn_with_backoff(rep)
         with self._lock:
@@ -649,6 +711,8 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--platform", default="cpu")
     parser.add_argument("--no-prewarm", action="store_true")
     parser.add_argument("--metrics-jsonl", default=None)
+    parser.add_argument("--compile-cache-dir", default=None)
+    parser.add_argument("--artifact", default=None)
     args = parser.parse_args(argv)
 
     # Pin this process's devices BEFORE any model load touches the
@@ -671,8 +735,31 @@ def main(argv: list[str] | None = None) -> int:
 
         REGISTRY.add_sink(JsonlSink(args.metrics_jsonl))
 
+    # Cold-start plane: persistent compile cache on (when configured)
+    # BEFORE the first jit, then the model load — off the mmapped baked
+    # artifact when the handshake shipped one — then the bounded shape
+    # lattice traced, so READY means "every geometry this worker can
+    # dispatch is compiled or cache-hit" (docs/PERFORMANCE.md §12). The
+    # warmup span (load + prewarm, imports excluded) rides the READY line:
+    # it is the cold-start wall this plane exists to knock down, measured
+    # identically for cold and warm spawns.
+    from ..artifacts.compile_cache import enable_compile_cache, prewarm_lattice
+
+    cache_dir = enable_compile_cache(args.compile_cache_dir)
+    t_warm = time.perf_counter()
     registry = ModelRegistry()
-    registry.load(args.model_dir, prewarm=not args.no_prewarm)
+    # The lattice prewarm below covers every geometry the registry's own
+    # two-doc prewarm would trace (and more), so skip the double warm.
+    registry.load(args.model_dir, artifact=args.artifact, prewarm=False)
+    runner = registry.peek().runner
+    prewarm_mode = None
+    if not args.no_prewarm:
+        # Roofline diagnostics re-lower the dispatch program; on a small
+        # host that analysis would serialize with (and dominate) the
+        # measured warmup, so defer it until after READY.
+        runner._cost_recorded = True
+        prewarm_mode = prewarm_lattice(runner, cache_dir=cache_dir)["mode"]
+    warmup_s = time.perf_counter() - t_warm
     server = ServingServer(registry, host=args.host, port=args.port).start()
     ready = {
         "name": args.name,
@@ -685,8 +772,35 @@ def main(argv: list[str] | None = None) -> int:
         # differences it against its own to sync the two captures
         # (telemetry.stitch).
         "ts": time.time(),
+        "warmup_s": warmup_s,
+        "prewarm_mode": prewarm_mode,
     }
     print(READY_PREFIX + json.dumps(ready), flush=True)
+
+    if not args.no_prewarm:
+        # The deferred roofline gauges: recorded off the serving path now
+        # that READY is out, at the lattice's smallest dispatch geometry.
+        def _deferred_cost():
+            try:
+                from ..resilience import faults
+                from ..telemetry import cost as cost_mod
+
+                # Shielded: the analysis re-traces the instrumented
+                # dispatch, and an env-armed chaos plan must spend its
+                # call budget on serving attempts, not diagnostics.
+                with faults.shield():
+                    cost_mod.record_runner_cost(
+                        runner, 1, runner.length_buckets[0]
+                    )
+            except Exception:
+                pass
+
+        # Non-daemon: a worker told to stop seconds after READY must join
+        # this (bounded) analysis rather than let interpreter teardown
+        # abort a live XLA compile.
+        threading.Thread(
+            target=_deferred_cost, name="replica-cost-gauges", daemon=False
+        ).start()
 
     def _sigterm(signum, frame):
         raise SystemExit(0)

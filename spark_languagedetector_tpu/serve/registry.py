@@ -212,12 +212,23 @@ class ModelRegistry:
             self._retire(old)
         return version
 
-    def load(self, path: str, **kw) -> str:
+    def load(self, path: str, *, artifact: str | None = None, **kw) -> str:
         """Load a persisted model directory (``persist.load_model`` layout)
-        into a standby runner and swap it in."""
-        from ..models.estimator import LanguageDetectorModel
+        into a standby runner and swap it in.
 
-        model = LanguageDetectorModel.load(path)
+        Cold-start fast path: when a baked artifact exists for ``path``
+        (the explicit ``artifact`` path, else the ``.baked`` sibling /
+        ``LANGDETECT_ARTIFACT_DIR`` resolution), the model is mmapped off
+        it instead of parsed out of parquet — bit-identical scores, with
+        the parquet tree as the fallback for a missing or torn artifact
+        (docs/PERFORMANCE.md §12)."""
+        from ..artifacts.bake import maybe_load_baked
+
+        model = maybe_load_baked(path, artifact)
+        if model is None:
+            from ..models.estimator import LanguageDetectorModel
+
+            model = LanguageDetectorModel.load(path)
         return self.install(model, source=str(path), **kw)
 
     def rollback(self) -> str:

@@ -59,6 +59,17 @@ _TRACKED_GAUGES = {
     "langdetect_fit_collect_bytes": "fit_collect_bytes",
 }
 
+# Cold-start histograms (docs/PERFORMANCE.md §12): spawn-to-READY and
+# zoo cold-load walls are tracked regression metrics — their p50 is
+# diffed alongside the default mean/p99, because the cold-start plane's
+# whole value proposition is the *typical* spawn collapsing once the
+# compile cache and baked artifacts are warm; a p99 blown out by one
+# first-ever spawn must not mask a p50 regression (or hide a p50 win).
+# Module-level on purpose: the static contract checker (analysis/, R2)
+# verifies each name is emitted somewhere, so a renamed histogram fails
+# tier-1 instead of silently never regressing.
+_COLD_START_HISTOGRAMS = ("scale/spawn_ready_s", "zoo/cold_load_s")
+
 # Aggregate fill-ratio contract metrics re-derived from the last
 # snapshot's exact byte/row counters (the per-batch histograms are sampled
 # reservoirs; these are whole-run truth): real bytes over capacity bytes
@@ -92,6 +103,11 @@ _RELIABILITY_COUNTERS = (
     # baseline is an isolation/availability regression, full stop.
     "zoo/cross_tenant_rejects",
     "zoo/load_errors",
+    # Cold-start plane (docs/PERFORMANCE.md §12): a baked artifact being
+    # refused (torn/foreign) on a fixed workload means cold loads are
+    # silently falling back to the parquet parse — the fast path is
+    # dark, the spawn budget quietly regresses.
+    "artifacts/load_errors",
     # Elastic fleet (docs/SERVING.md §13): a replica spawn failing or a
     # supervised restart firing against a clean baseline means replicas
     # are dying or failing to come up — reliability regressions both.
@@ -381,7 +397,10 @@ def compare_captures(
     b_h, n_h = base["histograms"], new["histograms"]
     for name in sorted(set(b_h) & set(n_h)):
         b, n = b_h[name], n_h[name]
-        for m in ("mean", "p99"):
+        hist_metrics = ("mean", "p99")
+        if name in _COLD_START_HISTOGRAMS:
+            hist_metrics = ("mean", "p50", "p99")
+        for m in hist_metrics:
             delta = _rel_delta(b.get(m), n.get(m))
             if delta is None:
                 continue

@@ -56,6 +56,13 @@ _PLATFORM_PEAKS: dict[str, tuple[float, float]] = {
 # should settle for the pre-compile analysis.
 _COMPILE_FOR_COST_MAX_ELEMS = 1 << 24
 
+# Same guard for the runner dispatch program, in resident-table bytes:
+# the analysis lambda closes over the runner's device tables, so they
+# lower as literal constants — past this size the backend compile spends
+# seconds constant-folding a table the real (argument-passing) dispatch
+# program never embeds, for a gauge. Pre-compile analysis instead.
+_COMPILE_FOR_COST_MAX_TABLE_BYTES = 4 * _COMPILE_FOR_COST_MAX_ELEMS
+
 
 def peak_rates(platform: str, env=os.environ) -> tuple[float, float] | None:
     """(peak flops/s, peak bytes/s) for a platform; env vars override
@@ -171,7 +178,11 @@ def record_runner_cost(
     ``program_flops{program="score/dispatch"}`` — the span path whose
     count matches one dispatch per call. Mesh runners are skipped: the
     GSPMD program's analysis is per-process, not per-chip, and would
-    misstate utilization.
+    misstate utilization. Runners whose resident tables exceed
+    ``_COMPILE_FOR_COST_MAX_TABLE_BYTES`` settle for the pre-compile
+    analysis even on CPU — the diagnostic lowering embeds the tables as
+    literals, and constant-folding them dwarfs the dispatch compile it
+    is modeling.
 
     Approximation note: the modeled program is the *padded* [rows,
     pad_to] dispatch. Ragged-transfer runners actually run device-side
@@ -193,15 +204,20 @@ def record_runner_cost(
 
         reg = registry if registry is not None else REGISTRY
         try:
-            reg.set_gauge(
-                "langdetect_table_bytes",
-                float(runner.table_bytes()),
-                program="score/dispatch",
-                quant=getattr(runner, "quantization", None) or "f32",
-                strategy=runner.strategy,
-            )
+            table_bytes = float(runner.table_bytes())
         except Exception:
-            pass
+            table_bytes = None
+        if table_bytes is not None:
+            try:
+                reg.set_gauge(
+                    "langdetect_table_bytes",
+                    table_bytes,
+                    program="score/dispatch",
+                    quant=getattr(runner, "quantization", None) or "f32",
+                    strategy=runner.strategy,
+                )
+            except Exception:
+                pass
         if runner.mesh is not None:
             return None
         batch = jax.ShapeDtypeStruct((int(rows), int(pad_to)), jnp.uint8)
@@ -211,7 +227,11 @@ def record_runner_cost(
             lambda b, l: runner._dispatch_device(b, l, None, None),
             batch,
             lengths,
-            prefer_compiled=(platform == "cpu"),
+            prefer_compiled=(
+                platform == "cpu"
+                and table_bytes is not None
+                and table_bytes <= _COMPILE_FOR_COST_MAX_TABLE_BYTES
+            ),
         )
         record_program_cost(
             "score/dispatch", cost, platform=platform, registry=registry

@@ -64,8 +64,13 @@ def install_jax_hooks(registry: Registry | None = None) -> bool:
             return
         if event == _CACHE_HIT_EVENT:
             reg.incr("jax/compile_cache_hits")
+            # Canonical slash-path spelling for /varz and bench telemetry
+            # blocks; the legacy jax/ name stays for dashboards that
+            # already scrape it.
+            reg.incr("compile_cache/hits")
         elif event == _CACHE_MISS_EVENT:
             reg.incr("jax/compile_cache_misses")
+            reg.incr("compile_cache/misses")
 
     def on_duration(event: str, duration: float, **kwargs) -> None:
         reg = _hooks_registry

@@ -370,6 +370,15 @@ class ModelZoo:
             faults.inject("zoo/load")
             model = entry.model
             if model is None:
+                # Cold-start plane: a baked artifact for the tenant's
+                # source tree turns the parquet parse into an mmap page-in
+                # — and N tenants baked into one artifact dir share page
+                # cache across repeated load/evict cycles
+                # (docs/PERFORMANCE.md §12). Parquet stays the fallback.
+                from ..artifacts.bake import maybe_load_baked
+
+                model = maybe_load_baked(entry.source)
+            if model is None:
                 from ..models.estimator import LanguageDetectorModel
 
                 model = LanguageDetectorModel.load(entry.source)
@@ -406,6 +415,9 @@ class ModelZoo:
             )
         self._finish_evictions()
         REGISTRY.incr("zoo/cold_loads")
+        # Latency next to the count: the cold-start wall per tenant, a
+        # tracked regression metric (telemetry/compare diffs its p50).
+        REGISTRY.observe("zoo/cold_load_s", time.perf_counter() - t0)
         log_event(
             _log, "zoo.cold_load", tenant=entry.name,
             version=entry.version, loads=entry.loads,

@@ -1,0 +1,350 @@
+"""Cold-start plane (docs/PERFORMANCE.md §12): baked mmap artifacts,
+crash-atomic bake/recovery, the persistent-compile-cache manifest, and the
+spawn-handshake smoke gate.
+
+The bit-parity contract under test: a quantized bake stores the same
+integer rows + per-language f32 scales as the parquet quantization codec,
+so a baked model and a parquet-loaded model reconstruct the identical f64
+weight matrix and score bit-identically. The crash-atomicity contract
+mirrors persist/io: a SIGKILL mid-bake leaves a torn tmp whose header
+parses but whose blocks are truncated — the loader must refuse it and the
+sibling-promotion recovery must never promote it.
+"""
+
+import json
+import os
+import shutil
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from spark_languagedetector_tpu.artifacts.bake import (
+    BLOCKS_NAME,
+    ArtifactError,
+    artifact_path_for,
+    bake_model,
+    load_artifact,
+    load_baked_model,
+    maybe_load_baked,
+    recover_artifact,
+)
+from spark_languagedetector_tpu.models.estimator import LanguageDetectorModel
+from spark_languagedetector_tpu.telemetry import REGISTRY
+
+LANGS = ("de", "en", "fr")
+
+
+def _model(seed=0, gram_lengths=(1, 2)):
+    rng = np.random.default_rng(seed)
+    grams = {}
+    for n in gram_lengths:
+        for _ in range(120):
+            g = bytes(rng.integers(97, 123, size=n).tolist())
+            grams[g] = rng.random(len(LANGS)).tolist()
+    return LanguageDetectorModel.from_gram_map(grams, gram_lengths, LANGS)
+
+
+def _counter(name: str) -> int:
+    return int(REGISTRY.snapshot()["counters"].get(name, 0))
+
+
+# ------------------------------------------------------------- bit parity --
+def test_baked_bit_identical_to_parquet_quantized(tmp_path):
+    """baked→load reconstructs the exact arrays the parquet quantization
+    codec reconstructs: same f64 weights (q * scale product), same ids,
+    same device membership tables, bit-identical scores."""
+    model = _model()
+    md = tmp_path / "model"
+    model.write().overwrite().quantized("int8").save(str(md))
+    loaded = LanguageDetectorModel.load(str(md))
+
+    art = bake_model(model, artifact_path_for(md), quantize="int8")
+    baked = load_baked_model(art)
+
+    assert np.array_equal(
+        np.asarray(loaded.profile.weights), np.asarray(baked.profile.weights)
+    )
+    assert np.array_equal(
+        np.asarray(loaded.profile.ids), np.asarray(baked.profile.ids)
+    )
+    lw, llut, lck = loaded.profile.device_membership()
+    pb = baked._prebuilt_membership
+    assert np.array_equal(np.asarray(lw), np.asarray(pb["weights"]))
+    if llut is None:
+        assert pb["lut"] is None
+    else:
+        assert np.array_equal(np.asarray(llut), np.asarray(pb["lut"]))
+    assert (lck is None) == (pb["cuckoo"] is None)
+
+    docs = [b"abc abc xyz", b"qqrrss", b"the quick brown fox", b"zz"]
+    s_parquet = np.asarray(loaded._get_runner().score(docs))
+    s_baked = np.asarray(baked._get_runner().score(docs))
+    assert np.array_equal(s_parquet, s_baked)
+
+
+def test_baked_cuckoo_form_round_trips(tmp_path):
+    """Gram lengths > 3 overflow the device-id LUT, so membership bakes
+    as cuckoo state; the loader must rebuild the identical table."""
+    model = _model(seed=3, gram_lengths=(2, 4))
+    md = tmp_path / "model"
+    model.save(str(md))
+    art = bake_model(model, artifact_path_for(md))
+    baked = load_baked_model(art)
+    ck = baked._prebuilt_membership["cuckoo"]
+    assert ck is not None
+    lw, llut, lck = model.profile.device_membership()
+    assert np.array_equal(np.asarray(lck.slots), np.asarray(ck.slots))
+    assert np.array_equal(np.asarray(lck.keys_lo), np.asarray(ck.keys_lo))
+    assert np.array_equal(np.asarray(lck.keys_hi), np.asarray(ck.keys_hi))
+    docs = [b"abcd efgh", b"wxyz"]
+    assert np.array_equal(
+        np.asarray(model._get_runner().score(docs)),
+        np.asarray(baked._get_runner().score(docs)),
+    )
+
+
+# ------------------------------------------------------- torn-write shapes --
+def test_torn_blocks_refused_and_parquet_fallback(tmp_path):
+    """The SIGKILL-mid-build shape: header parses, blocks truncated.
+    load_artifact refuses; maybe_load_baked counts the failure and falls
+    back (returns None so the caller parses parquet)."""
+    model = _model()
+    md = tmp_path / "model"
+    model.save(str(md))
+    art = Path(bake_model(model, artifact_path_for(md)))
+    blocks = art / BLOCKS_NAME
+    data = blocks.read_bytes()
+    blocks.write_bytes(data[: len(data) // 2])
+
+    with pytest.raises(ArtifactError, match="torn write"):
+        load_artifact(art)
+    before = _counter("artifacts/load_errors")
+    assert maybe_load_baked(md) is None
+    assert _counter("artifacts/load_errors") == before + 1
+
+
+def test_missing_end_magic_refused(tmp_path):
+    """Same byte count but the end magic overwritten: a plausible-length
+    file that never finished its final write is still refused."""
+    model = _model()
+    md = tmp_path / "model"
+    model.save(str(md))
+    art = Path(bake_model(model, artifact_path_for(md)))
+    blocks = art / BLOCKS_NAME
+    data = bytearray(blocks.read_bytes())
+    data[-8:] = b"\x00" * 8
+    blocks.write_bytes(bytes(data))
+    with pytest.raises(ArtifactError, match="magic"):
+        load_artifact(art)
+
+
+def test_recover_promotes_valid_sibling_never_torn(tmp_path):
+    """recover_artifact promotes the newest FULLY-validating sibling: a
+    torn tmp with a newer mtime is skipped, the older complete tree wins,
+    and the torn sibling is swept only after the promotion."""
+    model = _model()
+    md = tmp_path / "model"
+    model.save(str(md))
+    root = artifact_path_for(md)
+    bake_model(model, root)
+
+    good = root.parent / f".{root.name}.tmp.111"
+    os.replace(root, good)  # root gone: the crashed-mid-swap shape
+    torn = root.parent / f".{root.name}.tmp.222"
+    shutil.copytree(good, torn)
+    tb = torn / BLOCKS_NAME
+    tb.write_bytes(tb.read_bytes()[:-16])
+    future = time.time() + 60
+    os.utime(torn, (future, future))
+
+    assert recover_artifact(root) is True
+    baked = load_baked_model(root)  # the promoted tree fully validates
+    assert np.array_equal(
+        np.asarray(baked.profile.weights), np.asarray(model.profile.weights)
+    )
+    assert not list(root.parent.glob(f".{root.name}.tmp.*"))
+
+
+def test_recover_refuses_when_only_torn_candidates(tmp_path):
+    model = _model()
+    md = tmp_path / "model"
+    model.save(str(md))
+    root = artifact_path_for(md)
+    bake_model(model, root)
+    torn = root.parent / f".{root.name}.tmp.9"
+    os.replace(root, torn)
+    tb = torn / BLOCKS_NAME
+    tb.write_bytes(tb.read_bytes()[: 100])
+    assert recover_artifact(root) is False
+    assert not root.exists()
+    assert maybe_load_baked(md) is None  # parquet fallback, no crash
+
+
+# ------------------------------------------------------------ mmap sharing --
+def test_concurrent_readers_share_one_mapping(tmp_path):
+    """Two loads of one artifact must view the SAME buffer — zero-copy by
+    construction, so N replicas on a host share the page cache."""
+    model = _model()
+    md = tmp_path / "model"
+    model.save(str(md))
+    art = bake_model(model, artifact_path_for(md))
+
+    a1, a2 = load_artifact(art), load_artifact(art)
+    assert a1._buf is a2._buf
+    m1, m2 = load_baked_model(art), load_baked_model(art)
+    w1 = np.asarray(m1._prebuilt_membership["weights"])
+    w2 = np.asarray(m2._prebuilt_membership["weights"])
+    assert np.shares_memory(w1, w2)
+    # A re-bake is a different file generation: it must map fresh, not
+    # serve stale pages through the old key.
+    bake_model(model, art)
+    a3 = load_artifact(art)
+    assert a3._buf is not a1._buf
+
+
+# ------------------------------------------------------------- knob routing --
+def test_artifact_dir_knob_routes_through_exec_config(tmp_path, monkeypatch):
+    monkeypatch.setenv("LANGDETECT_ARTIFACT_DIR", str(tmp_path / "arts"))
+    got = artifact_path_for("/nowhere/model")
+    assert got == tmp_path / "arts" / "model.baked"
+    monkeypatch.delenv("LANGDETECT_ARTIFACT_DIR")
+    assert artifact_path_for("/nowhere/model") == Path("/nowhere/model.baked")
+
+
+def test_bake_on_save_knob(tmp_path, monkeypatch):
+    """LANGDETECT_BAKE_ON_SAVE=1: every native save also bakes, with the
+    same quantization codec the save used."""
+    monkeypatch.setenv("LANGDETECT_BAKE_ON_SAVE", "1")
+    model = _model()
+    md = tmp_path / "model"
+    model.write().overwrite().quantized("int8").save(str(md))
+    art = artifact_path_for(md)
+    assert art.exists()
+    baked = load_baked_model(art)
+    loaded = LanguageDetectorModel.load(str(md))
+    assert np.array_equal(
+        np.asarray(loaded.profile.weights), np.asarray(baked.profile.weights)
+    )
+
+
+# ----------------------------------------------- prewarm manifest mechanics --
+def _runner(model, buckets=(128, 256)):
+    from spark_languagedetector_tpu.api.runner import BatchRunner
+
+    w, lut, ck = model.profile.device_membership()
+    return BatchRunner(
+        weights=w, lut=lut, cuckoo=ck, spec=model.profile.spec,
+        strategy="gather", ragged_transfer=False, length_buckets=buckets,
+    )
+
+
+@pytest.fixture
+def compile_cache_dir(tmp_path):
+    import jax
+
+    from spark_languagedetector_tpu.artifacts.compile_cache import (
+        enable_compile_cache,
+    )
+
+    live = enable_compile_cache(str(tmp_path / "cc"))
+    yield Path(live)
+    jax.config.update("jax_compilation_cache_dir", None)
+
+
+def test_prewarm_full_trace_writes_manifest(compile_cache_dir):
+    from spark_languagedetector_tpu.artifacts.compile_cache import (
+        _lattice_signature, prewarm_lattice,
+    )
+
+    model = _model()
+    runner = _runner(model)
+    runner._cost_recorded = True
+    out = prewarm_lattice(runner, cache_dir=str(compile_cache_dir))
+    assert out["mode"] == "full"
+    assert out["buckets"] == [128, 256]
+    manifests = list(compile_cache_dir.glob("lattice-*.manifest.json"))
+    assert len(manifests) == 1
+    sig = json.loads(manifests[0].read_text())
+    assert sig == _lattice_signature(runner, (128, 256))
+
+
+def test_prewarm_sentinel_self_heals_on_cache_miss(compile_cache_dir):
+    """A manifest whose cache no longer serves (wiped entries — or, as
+    here, an in-process jit cache absorbing the sentinel trace so no
+    persistent-cache hit fires) must fall back to the full trace rather
+    than declare the lattice warm on faith. Cross-process sentinel
+    SUCCESS is gated end-to-end by the spawn smoke below."""
+    from spark_languagedetector_tpu.artifacts.compile_cache import (
+        prewarm_lattice,
+    )
+
+    model = _model()
+    r1 = _runner(model)
+    r1._cost_recorded = True
+    assert prewarm_lattice(r1, cache_dir=str(compile_cache_dir))["mode"] == "full"
+    r2 = _runner(model)
+    r2._cost_recorded = True
+    out = prewarm_lattice(r2, cache_dir=str(compile_cache_dir))
+    assert out["verified_hit"] is False
+    assert out["mode"] == "full"  # self-healed: every bucket traced
+
+
+def test_prewarm_signature_mismatch_forces_full_trace(compile_cache_dir):
+    """A different lattice (or any signature dimension) maps to a
+    different manifest: no sentinel shortcut across geometries."""
+    from spark_languagedetector_tpu.artifacts.compile_cache import (
+        prewarm_lattice,
+    )
+
+    model = _model()
+    r1 = _runner(model, buckets=(128,))
+    r1._cost_recorded = True
+    prewarm_lattice(r1, cache_dir=str(compile_cache_dir))
+    r2 = _runner(model, buckets=(128, 256))
+    r2._cost_recorded = True
+    out = prewarm_lattice(r2, cache_dir=str(compile_cache_dir))
+    assert out["mode"] == "full"
+    assert out["verified_hit"] is None  # sentinel never attempted
+    assert len(list(compile_cache_dir.glob("lattice-*.manifest.json"))) == 2
+
+
+def test_prewarm_without_cache_dir_never_writes_manifest(tmp_path):
+    from spark_languagedetector_tpu.artifacts.compile_cache import (
+        prewarm_lattice,
+    )
+
+    model = _model()
+    runner = _runner(model, buckets=(128,))
+    runner._cost_recorded = True
+    out = prewarm_lattice(runner)
+    assert out["mode"] == "full" and out["verified_hit"] is None
+
+
+# --------------------------------------------------------- bench smoke gate --
+def test_bench_smoke_spawn_trimmed(tmp_path):
+    """Tier-1-sized cold-start smoke: bake, spawn cold (full lattice
+    trace earns the manifest), spawn warm (sentinel-verified cache),
+    hard-gated exactly like the CI gate."""
+    import bench
+
+    result = bench.smoke_spawn(str(tmp_path / "spawn.jsonl"), trimmed=True)
+    assert result["ok"], result
+    assert result["cold"]["prewarm_mode"] == "full"
+    assert result["warm"]["prewarm_mode"] == "sentinel"
+    assert result["cold"]["compile_cache_misses"] > 0
+    assert result["warm"]["compile_cache_hits"] > 0
+    assert result["cold"]["first_dispatch_parity"] == 1.0
+    assert result["warm"]["first_dispatch_parity"] == 1.0
+    assert result["spawn_failures"] == 0
+
+
+@pytest.mark.slow
+def test_bench_smoke_spawn_full(tmp_path):
+    import bench
+
+    result = bench.smoke_spawn(str(tmp_path / "spawn_full.jsonl"))
+    assert result["ok"], result
+    assert result["warmup_ratio"] >= 3.0
+    assert result["lattice_buckets"] == 16

@@ -353,6 +353,13 @@ def _hedge_sequence(plan):
         want = [
             LANGS[int(i)] for i in runner.predict_ids(texts_to_bytes(TEXTS))
         ]
+        # The first dispatch defers cost-gauge analysis to a background
+        # thread (docs/PERFORMANCE.md §12); its AOT compile would add
+        # CPU noise to run `a` but not run `b` of the replay pair.
+        # Quiesce it before the latency-sensitive hedge schedule.
+        t = getattr(runner, "_cost_thread", None)
+        if t is not None:
+            t.join(timeout=120)
         out = []
         with faults.plan_scope(FaultPlan.parse(plan)):
             for _ in range(4):
